@@ -1,0 +1,22 @@
+"""pixie_tpu: a TPU-native telemetry-analytics framework with the capabilities of Pixie.
+
+Architecture (see ARCHITECTURE.md): telemetry enters an in-memory columnar table store
+where variable-width values (strings, 128-bit UPIDs) are dictionary-encoded to dense
+int32 codes at ingest.  PxL queries compile through an IR into plan fragments; each
+fragment is lowered to a single fused `jax.jit` function over fixed-shape padded
+columnar tensors and executed on TPU.  Distribution is SPMD: the same fragment runs
+over a `jax.sharding.Mesh` with partial aggregates merged by XLA collectives (psum)
+instead of the reference's per-node C++ exec + gRPC result streams.
+
+Reference parity map: /root/reference (easyops-cn/pixie), see SURVEY.md.
+"""
+import jax as _jax
+
+# Timestamps are int64 nanoseconds (TIME64NS, reference src/shared/types/typespb/
+# types.proto:26-33); the engine therefore requires 64-bit mode globally.
+_jax.config.update("jax_enable_x64", True)
+
+from pixie_tpu.types import DataType, SemanticType, Relation  # noqa: E402,F401
+from pixie_tpu.table import Table, TableStore, RowBatch  # noqa: E402,F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.table.table import Table, TableStore
+
+__all__ = ["Dictionary", "RowBatch", "Table", "TableStore"]

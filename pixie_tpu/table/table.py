@@ -1,0 +1,274 @@
+"""In-memory columnar table store.
+
+Parity with reference src/table_store/table/table.h and table_store.h:79, redesigned
+for XLA static shapes:
+
+  * Hot side: an open RowBatchBuilder accumulating appended records (reference "hot"
+    partition).
+  * Cold side: sealed batches of exactly `batch_rows` rows — the compaction unit
+    (reference CompactHotToCold, table.h:166, 64KiB cold batches table.h:64-67).
+    Fixed row counts mean every query over cold data reuses one compiled XLA
+    program per fragment, no recompiles.
+  * Ring-buffer expiry by byte budget (reference table.h expiry).
+  * Time+row-id indexed cursor (reference Cursor, table.h:76-124): batch-level
+    pruning on [min_time, max_time]; fine-grained time bounds are applied by the
+    executor as a row mask inside the jitted fragment.
+  * Dictionary encoding of STRING/UINT128 columns happens here, at write time.
+
+Thread model: one writer per table (the collector poll loop) + concurrent readers;
+a lock guards the batch list and builder swap, matching the reference's spinlocked
+hot/cold partitions (table.h:174-190, ABSL_GUARDED_BY annotations).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from pixie_tpu.status import InvalidArgument, NotFound
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.table.row_batch import RowBatch
+from pixie_tpu.types import STORAGE_DTYPE, DataType, Relation, is_dict_encoded
+
+DEFAULT_BATCH_ROWS = 1 << 16
+DEFAULT_TABLE_BYTES = 256 * 1024 * 1024
+
+
+class _SealedBatch:
+    __slots__ = ("batch", "row_id_start", "min_time", "max_time", "nbytes", "gen")
+
+    def __init__(self, batch: RowBatch, row_id_start: int, time_col: str | None, gen: int):
+        self.batch = batch
+        self.row_id_start = row_id_start
+        self.gen = gen  # monotonically increasing seal id; used as device-cache key
+        if time_col is not None and batch.num_valid > 0:
+            t = batch.columns[time_col][: batch.num_valid]
+            self.min_time = int(t.min())
+            self.max_time = int(t.max())
+        else:
+            self.min_time = None
+            self.max_time = None
+        self.nbytes = batch.nbytes()
+
+
+class Table:
+    """One telemetry table: schema + dictionaries + hot builder + sealed batches."""
+
+    def __init__(
+        self,
+        name: str,
+        relation: Relation,
+        max_bytes: int = DEFAULT_TABLE_BYTES,
+        batch_rows: int = DEFAULT_BATCH_ROWS,
+    ):
+        self.name = name
+        self.relation = relation
+        self.max_bytes = max_bytes
+        self.batch_rows = batch_rows
+        self.time_col = "time_" if "time_" in relation else None
+        self.dictionaries: dict[str, Dictionary] = {
+            c.name: Dictionary() for c in relation if is_dict_encoded(c.data_type)
+        }
+        self._lock = threading.Lock()
+        self._sealed: list[_SealedBatch] = []
+        self._hot: dict[str, list[np.ndarray]] = {c.name: [] for c in relation}
+        self._hot_rows = 0
+        self._next_row_id = 0
+        self._next_gen = 0
+        self._sealed_bytes = 0
+        self._expired_batches = 0
+        self._total_rows_written = 0
+
+    # ------------------------------------------------------------------ write
+    def write(self, data: dict) -> int:
+        """Append a record batch given as {col: sequence}. Returns rows written.
+
+        Reference: Table::WriteRowBatch / TransferRecordBatch (table.h:152-155).
+        Encodes dict-typed columns; seals full `batch_rows` chunks.
+        """
+        # Validate shape before touching dictionaries: a rejected write must not
+        # leak values into the append-only dictionaries.
+        n = None
+        for c in self.relation:
+            if c.name not in data:
+                raise InvalidArgument(f"write to {self.name}: missing column {c.name}")
+            ln = len(data[c.name])
+            if n is None:
+                n = ln
+            elif ln != n:
+                raise InvalidArgument(f"write to {self.name}: ragged columns")
+        cols: dict[str, np.ndarray] = {}
+        for c in self.relation:
+            v = data[c.name]
+            if c.name in self.dictionaries:
+                cols[c.name] = self.dictionaries[c.name].encode(v)
+            else:
+                cols[c.name] = np.asarray(v, dtype=STORAGE_DTYPE[c.data_type])
+        if not n:
+            return 0
+        with self._lock:
+            for k, v in cols.items():
+                self._hot[k].append(v)
+            self._hot_rows += n
+            self._total_rows_written += n
+            while self._hot_rows >= self.batch_rows:
+                self._seal_locked()
+            self._expire_locked()
+        return n
+
+    def _take_hot_locked(self) -> dict[str, np.ndarray]:
+        merged = {
+            k: (np.concatenate(v) if len(v) != 1 else v[0]) if v else
+            np.empty(0, dtype=STORAGE_DTYPE[self.relation.dtype(k)])
+            for k, v in self._hot.items()
+        }
+        return merged
+
+    def _seal_locked(self):
+        merged = self._take_hot_locked()
+        take = self.batch_rows
+        # Copy the sealed slice so expiry actually frees memory — a view would pin
+        # the whole concatenated hot buffer alive for as long as any sibling lives.
+        batch_cols = {k: v[:take].copy() for k, v in merged.items()}
+        rest = {k: [v[take:]] if len(v) > take else [] for k, v in merged.items()}
+        rb = RowBatch(self.relation, batch_cols)
+        sb = _SealedBatch(rb, self._next_row_id, self.time_col, self._next_gen)
+        self._next_gen += 1
+        self._sealed.append(sb)
+        self._sealed_bytes += sb.nbytes
+        self._next_row_id += rb.num_rows
+        self._hot = rest
+        self._hot_rows -= take
+
+    def _expire_locked(self):
+        # Ring-buffer semantics: oldest sealed batches fall off when over budget
+        # (reference table.h expiry by table_size_limit).
+        while self._sealed and self._sealed_bytes + self._hot_bytes_locked() > self.max_bytes:
+            sb = self._sealed.pop(0)
+            self._sealed_bytes -= sb.nbytes
+            self._expired_batches += 1
+
+    def _hot_bytes_locked(self) -> int:
+        return sum(a.nbytes for arrs in self._hot.values() for a in arrs)
+
+    # ------------------------------------------------------------------- read
+    def cursor(
+        self,
+        start_time: int | None = None,
+        stop_time: int | None = None,
+        include_hot: bool = True,
+    ) -> "Cursor":
+        """Snapshot cursor over sealed batches (+ a padded snapshot of hot rows)."""
+        with self._lock:
+            sealed = list(self._sealed)
+            hot = None
+            if include_hot and self._hot_rows > 0:
+                merged = self._take_hot_locked()
+                hot = RowBatch(self.relation, merged)
+            hot_row_id = self._next_row_id
+        return Cursor(self, sealed, hot, hot_row_id, start_time, stop_time)
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "batches": len(self._sealed),
+                "hot_rows": self._hot_rows,
+                "rows_written": self._total_rows_written,
+                "bytes": self._sealed_bytes + self._hot_bytes_locked(),
+                "expired_batches": self._expired_batches,
+                "dict_sizes": {k: d.size for k, d in self.dictionaries.items()},
+            }
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return (
+                self._sealed_bytes
+                + self._hot_bytes_locked()
+                + sum(d.nbytes() for d in self.dictionaries.values())
+            )
+
+
+class Cursor:
+    """Time-bounded batch iterator with snapshot isolation (reference table.h:76-124).
+
+    Yields (RowBatch, row_id_start, gen). `gen` is None for the hot remainder batch
+    (not device-cacheable); sealed batches carry a stable gen for device caching.
+    Batch-level time pruning only — callers apply exact row-level time bounds as a
+    mask (the executor folds it into the fragment's filter).
+    """
+
+    def __init__(self, table, sealed, hot, hot_row_id, start_time, stop_time):
+        self.table = table
+        self.start_time = start_time
+        self.stop_time = stop_time
+        self._items: list[tuple[RowBatch, int, int | None]] = []
+        for sb in sealed:
+            if start_time is not None and sb.max_time is not None and sb.max_time < start_time:
+                continue
+            if stop_time is not None and sb.min_time is not None and sb.min_time >= stop_time:
+                continue
+            self._items.append((sb.batch, sb.row_id_start, sb.gen))
+        if hot is not None:
+            tc = table.time_col
+            keep = True
+            if tc is not None and hot.num_valid > 0:
+                t = hot.columns[tc]
+                if start_time is not None and t.max() < start_time:
+                    keep = False
+                if stop_time is not None and t.min() >= stop_time:
+                    keep = False
+            if keep:
+                self._items.append((hot, hot_row_id, None))
+
+    def __iter__(self) -> Iterator[tuple[RowBatch, int, int | None]]:
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def num_rows(self) -> int:
+        return sum(b.num_valid for b, _, _ in self._items)
+
+
+class TableStore:
+    """Name → Table map (reference src/table_store/table/table_store.h:79)."""
+
+    def __init__(self):
+        self._tables: dict[str, Table] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str, relation: Relation, **kw) -> Table:
+        with self._lock:
+            if name in self._tables:
+                raise InvalidArgument(f"table {name} already exists")
+            t = Table(name, relation, **kw)
+            self._tables[name] = t
+            return t
+
+    def add_table(self, table: Table):
+        with self._lock:
+            self._tables[table.name] = table
+
+    def table(self, name: str) -> Table:
+        t = self._tables.get(name)
+        if t is None:
+            raise NotFound(f"table {name!r} not found (have {sorted(self._tables)})")
+        return t
+
+    def has(self, name: str) -> bool:
+        return name in self._tables
+
+    def names(self) -> list[str]:
+        return sorted(self._tables)
+
+    def relation(self, name: str) -> Relation:
+        return self.table(name).relation
+
+    def schemas(self) -> dict[str, Relation]:
+        return {n: t.relation for n, t in self._tables.items()}
+
+    def stats(self) -> list[dict]:
+        return [t.stats() for t in self._tables.values()]

@@ -1,0 +1,21 @@
+"""Test configuration.
+
+Mirrors the reference's "every distributed behavior has an in-process seam"
+strategy (SURVEY.md §4): all tests run on CPU with 8 virtual XLA devices so
+mesh/collective paths are exercised without TPU hardware.
+"""
+import os
+
+# Must be set before jax import.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

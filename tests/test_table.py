@@ -1,0 +1,142 @@
+"""Table store tests (parity targets: reference src/table_store/table/table_test.cc)."""
+import numpy as np
+import pytest
+
+from pixie_tpu.status import InvalidArgument, NotFound
+from pixie_tpu.table import Dictionary, RowBatch, Table, TableStore
+from pixie_tpu.types import DataType, Relation
+
+REL = Relation.of(
+    ("time_", DataType.TIME64NS),
+    ("service", DataType.STRING),
+    ("latency", DataType.FLOAT64),
+    ("status", DataType.INT64),
+)
+
+
+def make_table(**kw):
+    return Table("http_events", REL, **kw)
+
+
+def write_rows(t, n, t0=0):
+    t.write(
+        {
+            "time_": np.arange(t0, t0 + n, dtype=np.int64),
+            "service": [f"svc{i % 3}" for i in range(n)],
+            "latency": np.random.rand(n),
+            "status": np.full(n, 200, dtype=np.int64),
+        }
+    )
+
+
+class TestDictionary:
+    def test_encode_roundtrip(self):
+        d = Dictionary()
+        codes = d.encode(["b", "a", "b", "c"])
+        assert codes.dtype == np.int32
+        assert d.decode(codes) == ["b", "a", "b", "c"]
+        # Codes are stable across batches.
+        codes2 = d.encode(["c", "a"])
+        assert d.decode(codes2) == ["c", "a"]
+        assert codes2[1] == codes[1]
+
+    def test_get_code_absent(self):
+        d = Dictionary(["x"])
+        assert d.get_code("x") == 0
+        assert d.get_code("nope") == -1
+        assert len(d) == 1
+
+    def test_lut(self):
+        d = Dictionary(["apple", "banana", "fig"])
+        lut = d.lut(lambda s: len(s), np.int64)
+        np.testing.assert_array_equal(lut, [5, 6, 3])
+
+    def test_translate(self):
+        a = Dictionary(["x", "y", "z"])
+        b = Dictionary(["z", "x"])
+        lut = a.translate_to(b, insert=False)
+        np.testing.assert_array_equal(lut, [1, -1, 0])
+        lut2 = a.translate_to(b, insert=True)
+        np.testing.assert_array_equal(lut2, [1, 2, 0])
+        assert b.value(2) == "y"
+
+
+class TestRowBatch:
+    def test_pad_and_compact(self):
+        rb = RowBatch(REL.select(["time_"]), {"time_": np.arange(5, dtype=np.int64)})
+        p = rb.pad_to(8)
+        assert p.num_rows == 8 and p.num_valid == 5
+        c = p.compact()
+        np.testing.assert_array_equal(c.col("time_"), np.arange(5))
+
+    def test_ragged_rejected(self):
+        with pytest.raises(ValueError):
+            RowBatch(
+                REL.select(["time_", "status"]),
+                {"time_": np.arange(5, dtype=np.int64), "status": np.arange(4, dtype=np.int64)},
+            )
+
+
+class TestTable:
+    def test_write_seal_cursor(self):
+        t = make_table(batch_rows=100)
+        write_rows(t, 250)
+        s = t.stats()
+        assert s["batches"] == 2 and s["hot_rows"] == 50
+        cur = t.cursor()
+        assert cur.num_rows() == 250
+        items = list(cur)
+        assert len(items) == 3
+        # Sealed batches have stable gens; hot batch has gen None.
+        assert items[0][2] == 0 and items[1][2] == 1 and items[2][2] is None
+        # Row ids line up.
+        assert [it[1] for it in items] == [0, 100, 200]
+
+    def test_string_encoding(self):
+        t = make_table(batch_rows=10)
+        write_rows(t, 10)
+        (rb, _, _) = next(iter(t.cursor()))
+        assert rb.col("service").dtype == np.int32
+        decoded = t.dictionaries["service"].decode(rb.col("service"))
+        assert decoded[:4] == ["svc0", "svc1", "svc2", "svc0"]
+
+    def test_time_pruning(self):
+        t = make_table(batch_rows=100)
+        write_rows(t, 300)  # times 0..299
+        cur = t.cursor(start_time=150, stop_time=250)
+        # batch [0..99] pruned; [100..199], [200..299] kept.
+        assert len(cur) == 2
+
+    def test_expiry(self):
+        t = make_table(batch_rows=100, max_bytes=10_000)
+        write_rows(t, 2000)
+        s = t.stats()
+        assert s["expired_batches"] > 0
+        assert t.nbytes() < 40_000
+        # Oldest data gone, newest retained.
+        cur = t.cursor()
+        first_batch = next(iter(cur))[0]
+        assert first_batch.col("time_")[0] > 0
+
+    def test_missing_column_rejected(self):
+        t = make_table()
+        with pytest.raises(InvalidArgument):
+            t.write({"time_": [1]})
+
+    def test_write_returns_rows(self):
+        t = make_table()
+        write_rows(t, 7)
+        assert t.stats()["rows_written"] == 7
+
+
+class TestTableStore:
+    def test_create_get(self):
+        ts = TableStore()
+        ts.create("a", REL)
+        assert ts.has("a")
+        assert ts.relation("a") == REL
+        with pytest.raises(NotFound):
+            ts.table("b")
+        with pytest.raises(InvalidArgument):
+            ts.create("a", REL)
+        assert ts.names() == ["a"]

@@ -1,0 +1,47 @@
+import numpy as np
+import pytest
+
+from pixie_tpu.types import (
+    STORAGE_DTYPE,
+    ColumnSchema,
+    DataType,
+    Relation,
+    SemanticType,
+    UInt128,
+    is_dict_encoded,
+)
+
+
+def test_storage_dtypes():
+    assert STORAGE_DTYPE[DataType.TIME64NS] == np.int64
+    assert STORAGE_DTYPE[DataType.STRING] == np.int32
+    assert is_dict_encoded(DataType.UINT128)
+    assert not is_dict_encoded(DataType.FLOAT64)
+
+
+def test_relation():
+    r = Relation.of(
+        ("time_", DataType.TIME64NS),
+        ("pod", DataType.STRING, SemanticType.ST_POD_NAME),
+    )
+    assert r.names() == ["time_", "pod"]
+    assert r.col("pod").semantic_type == SemanticType.ST_POD_NAME
+    assert "time_" in r and "nope" not in r
+    r2 = r.add(ColumnSchema("x", DataType.INT64))
+    assert len(r2) == 3 and len(r) == 2
+    assert r2.select(["x", "time_"]).names() == ["x", "time_"]
+    with pytest.raises(KeyError):
+        r.col("nope")
+    rt = Relation.from_dict(r2.to_dict())
+    assert rt == r2
+
+
+def test_relation_dup_rejected():
+    with pytest.raises(ValueError):
+        Relation.of(("a", DataType.INT64), ("a", DataType.INT64))
+
+
+def test_upid():
+    u = UInt128.make_upid(asid=5, pid=1234, start_time_ns=999)
+    assert u.asid == 5 and u.pid == 1234 and u.low == 999
+    assert str(u) == "5:1234:999"
