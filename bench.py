@@ -1,0 +1,144 @@
+"""Benchmark: http_data-shaped query throughput (BASELINE config #1/#2).
+
+Measures end-to-end engine throughput (host table store → device kernels →
+finalized result) for filter + groupby(service,status) + count/mean/p50 over a
+synthetic http_events table, and compares against a pandas single-CPU oracle of
+the same query (the stand-in denominator for single-node CPU Carnot — the
+reference ships no absolute numbers, see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build_table(rows: int, batch_rows: int = 1 << 16):
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    rng = np.random.default_rng(12)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS),
+        ("service", DT.STRING),
+        ("latency", DT.FLOAT64),
+        ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=batch_rows, max_bytes=1 << 34)
+    services = np.array([f"svc-{i}" for i in range(16)])
+    chunk = 1 << 20
+    written = 0
+    while written < rows:
+        n = min(chunk, rows - written)
+        svc_idx = rng.integers(0, 16, n)
+        t.write(
+            {
+                "time_": (np.arange(written, written + n, dtype=np.int64)) * 1000,
+                "service": services[svc_idx],
+                "latency": rng.exponential(50.0, n),
+                "status": rng.choice([200, 404, 500], n, p=[0.85, 0.05, 0.10]),
+            }
+        )
+        written += n
+    return ts
+
+
+def build_plan():
+    from pixie_tpu.plan import (
+        AggExpr,
+        AggOp,
+        Call,
+        Column,
+        FilterOp,
+        MemorySinkOp,
+        MemorySourceOp,
+        Plan,
+        lit,
+    )
+
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events"))
+    f = p.add(FilterOp(expr=Call("not_equal", (Column("status"), lit(404)))), parents=[src])
+    agg = p.add(
+        AggOp(
+            groups=["service", "status"],
+            values=[
+                AggExpr("cnt", "count", None),
+                AggExpr("avg_lat", "mean", "latency"),
+                AggExpr("p50", "p50", "latency"),
+            ],
+        ),
+        parents=[f],
+    )
+    p.add(MemorySinkOp(name="output"), parents=[agg])
+    return p
+
+
+def pandas_baseline(ts, repeats: int = 1) -> float:
+    """Single-CPU columnar oracle of the same query; returns rows/sec."""
+    import pandas as pd
+
+    t = ts.table("http_events")
+    cur = t.cursor()
+    rows = cur.num_rows()
+    cols = {"service": [], "latency": [], "status": []}
+    for rb, _, _ in cur:
+        cols["service"].append(rb.columns["service"][: rb.num_valid])
+        cols["latency"].append(rb.columns["latency"][: rb.num_valid])
+        cols["status"].append(rb.columns["status"][: rb.num_valid])
+    df = pd.DataFrame({k: np.concatenate(v) for k, v in cols.items()})
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        sel = df[df.status != 404]
+        sel.groupby(["service", "status"]).agg(
+            cnt=("latency", "size"),
+            avg_lat=("latency", "mean"),
+            p50=("latency", "median"),
+        )
+        best = min(best, time.perf_counter() - t0)
+    return rows / best
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=4_000_000)
+    ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+    rows = 200_000 if args.smoke else args.rows
+
+    from pixie_tpu.engine import execute_plan
+
+    ts = build_table(rows)
+    plan = build_plan()
+    # Warm-up: compiles the fragment kernels.
+    execute_plan(plan, ts)
+    best = float("inf")
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        out = execute_plan(plan, ts)["output"]
+        best = min(best, time.perf_counter() - t0)
+    rows_per_sec = rows / best
+    assert out.num_rows > 0
+
+    base = pandas_baseline(ts, repeats=1)
+    print(
+        json.dumps(
+            {
+                "metric": "http_data_groupby_rows_per_sec",
+                "value": round(rows_per_sec),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+from pixie_tpu.engine.executor import execute_plan
+from pixie_tpu.engine.result import QueryResult
+
+__all__ = ["execute_plan", "QueryResult"]

@@ -1,0 +1,264 @@
+"""Expression compiler: plan Expr trees → device value builders.
+
+The TPU replacement for the reference's two scalar-expression evaluators
+(src/carnot/exec/expression_evaluator.h:135,157).  Where the reference walks the
+expression per batch calling UDF Exec loops, we compile the expression ONCE per
+query into a closure of pure jax ops that fuses into the fragment kernel, and do
+all string work at compile time against dictionary snapshots:
+
+  * numeric ops → jnp ops on column tensors (device, fused by XLA);
+  * string scalar UDFs → host evaluation over dictionary values producing LUT
+    arrays, applied on device with one gather;
+  * string equality / select → dictionary code translation at compile time,
+    integer compare / where on device.
+
+Compile-time value = SVal(dtype, dictionary, build) where build(env) emits the
+device array; env = {"cols": {...}, "luts": {...}}.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.plan.plan import Call, Column, Expr, Literal
+from pixie_tpu.status import CompilerError
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import DataType as DT
+from pixie_tpu.types import STORAGE_DTYPE
+
+_JNP_DTYPE = {
+    DT.BOOLEAN: jnp.bool_,
+    DT.INT64: jnp.int64,
+    DT.FLOAT64: jnp.float64,
+    DT.TIME64NS: jnp.int64,
+    DT.STRING: jnp.int32,
+    DT.UINT128: jnp.int32,
+}
+
+
+@dataclasses.dataclass
+class SVal:
+    dtype: DT
+    build: Callable  # env -> jax.Array
+    dictionary: Optional[Dictionary] = None  # for STRING / UINT128 values
+
+
+def apply_lut(lut: jax.Array, codes: jax.Array, fill):
+    """Safe LUT gather: codes may be -1 (null / no-translation) → fill."""
+    safe = jnp.clip(codes, 0, lut.shape[0] - 1)
+    out = jnp.take(lut, safe)
+    return jnp.where(codes >= 0, out, jnp.asarray(fill, dtype=out.dtype))
+
+
+class ExprCompiler:
+    """Compiles Exprs against a column environment (dtypes + dictionaries).
+
+    Collects LUT arrays into self.luts; the runner ships them to device once per
+    query and passes them via env["luts"].
+    """
+
+    def __init__(self, col_dtypes: dict[str, DT], col_dicts: dict[str, Dictionary], registry):
+        self.col_dtypes = col_dtypes
+        self.col_dicts = col_dicts
+        self.registry = registry
+        self.luts: dict[str, np.ndarray] = {}
+        self._n = 0
+        self._memo: dict[int, SVal] = {}
+
+    # ---------------------------------------------------------------- helpers
+    def _add_lut(self, arr: np.ndarray) -> str:
+        name = f"lut{self._n}"
+        self._n += 1
+        self.luts[name] = arr
+        return name
+
+    def _cast(self, v: SVal, target: DT) -> SVal:
+        if v.dtype == target:
+            return v
+        if target in (DT.FLOAT64, DT.INT64, DT.TIME64NS) and v.dtype in (
+            DT.BOOLEAN,
+            DT.INT64,
+            DT.FLOAT64,
+            DT.TIME64NS,
+        ):
+            dt = _JNP_DTYPE[target]
+            b = v.build
+            return SVal(target, lambda env, b=b, dt=dt: b(env).astype(dt))
+        raise CompilerError(f"cannot cast {v.dtype.name} to {target.name}")
+
+    # ------------------------------------------------------------------ entry
+    def compile(self, expr: Expr) -> SVal:
+        # Memoized so type-discovery passes don't duplicate LUT/dictionary work
+        # for nested host calls (and shared subexpressions compile once).
+        got = self._memo.get(id(expr))
+        if got is not None:
+            return got
+        if isinstance(expr, Column):
+            out = self._compile_column(expr)
+        elif isinstance(expr, Literal):
+            out = self._compile_literal(expr)
+        elif isinstance(expr, Call):
+            out = self._compile_call(expr)
+        else:
+            raise CompilerError(f"unknown expression node {type(expr).__name__}")
+        self._memo[id(expr)] = out
+        return out
+
+    def _compile_column(self, expr: Column) -> SVal:
+        name = expr.name
+        if name not in self.col_dtypes:
+            raise CompilerError(f"column {name!r} not found; have {sorted(self.col_dtypes)}")
+        dt = self.col_dtypes[name]
+        return SVal(dt, lambda env, name=name: env["cols"][name], self.col_dicts.get(name))
+
+    def _compile_literal(self, expr: Literal) -> SVal:
+        if expr.dtype == DT.STRING:
+            # Bare string literal outside a recognized string context: make a
+            # single-value dictionary; code 0 broadcast.
+            d = Dictionary([expr.value])
+            return SVal(
+                DT.STRING,
+                lambda env: jnp.zeros((), dtype=jnp.int32),
+                d,
+            )
+        dt = _JNP_DTYPE[expr.dtype]
+        v = expr.value
+        return SVal(expr.dtype, lambda env, v=v, dt=dt: jnp.asarray(v, dtype=dt))
+
+    # ------------------------------------------------------------------ calls
+    def _compile_call(self, call: Call) -> SVal:
+        fn = call.fn
+        arg_types = []
+        for a in call.args:
+            if isinstance(a, Literal):
+                arg_types.append(a.dtype)
+            else:
+                arg_types.append(self.compile(a).dtype)  # cheap: SVals are tiny
+
+        # String-aware structural forms handled before registry dispatch.
+        if fn in ("equal", "not_equal") and all(
+            t in (DT.STRING, DT.UINT128) for t in arg_types
+        ):
+            return self._string_equality(call, negate=(fn == "not_equal"))
+        if fn == "select" and len(call.args) == 3 and arg_types[1] == DT.STRING:
+            return self._string_select(call)
+
+        udf = self.registry.scalar(fn, arg_types)
+        if udf.device:
+            return self._device_call(call, udf, arg_types)
+        return self._host_call(call, udf, arg_types)
+
+    def _device_call(self, call: Call, udf, arg_types) -> SVal:
+        svals = []
+        for a, declared in zip(call.args, udf.arg_types):
+            v = self.compile(a)
+            if v.dtype != declared and declared in (DT.FLOAT64, DT.INT64):
+                v = self._cast(v, declared)
+            svals.append(v)
+        builders = [v.build for v in svals]
+        f = udf.fn
+
+        def build(env, f=f, builders=builders):
+            return f(*[b(env) for b in builders])
+
+        return SVal(udf.out_type, build)
+
+    def _host_call(self, call: Call, udf, arg_types) -> SVal:
+        """Host string UDF → LUT over the first arg's dictionary.
+
+        Layout convention: arg0 is the string column; the last `const_args` args
+        must be literals passed straight to the python fn.
+        """
+        s = self.compile(call.args[0])
+        if s.dictionary is None:
+            raise CompilerError(f"{udf.name}: first argument must be a string column")
+        consts = []
+        for a in call.args[1:]:
+            if not isinstance(a, Literal):
+                raise CompilerError(
+                    f"{udf.name}: argument {a!r} must be a literal (host UDFs evaluate "
+                    "over dictionaries, not rows)"
+                )
+            consts.append(a.value)
+        size = s.dictionary.size
+        if udf.out_type == DT.STRING:
+            out_dict = Dictionary()
+            lut = s.dictionary.lut(
+                lambda v: out_dict.code(udf.fn(v, *consts)), np.int32, size=size
+            )
+            name = self._add_lut(lut)
+            b = s.build
+            return SVal(
+                DT.STRING,
+                lambda env, name=name, b=b: apply_lut(env["luts"][name], b(env), -1),
+                out_dict,
+            )
+        np_out = STORAGE_DTYPE[udf.out_type]
+        lut = s.dictionary.lut(lambda v: udf.fn(v, *consts), np_out, size=size)
+        name = self._add_lut(lut)
+        b = s.build
+        fill = False if udf.out_type == DT.BOOLEAN else 0
+        return SVal(
+            udf.out_type,
+            lambda env, name=name, b=b, fill=fill: apply_lut(env["luts"][name], b(env), fill),
+        )
+
+    def _string_equality(self, call: Call, negate: bool) -> SVal:
+        lhs_e, rhs_e = call.args
+        # literal vs column: compare against the column dictionary's code.
+        if isinstance(rhs_e, Literal) or isinstance(lhs_e, Literal):
+            col_e, lit_e = (lhs_e, rhs_e) if isinstance(rhs_e, Literal) else (rhs_e, lhs_e)
+            v = self.compile(col_e)
+            if v.dictionary is None:
+                raise CompilerError("string equality against non-dictionary value")
+            code = v.dictionary.get_code(lit_e.value, -2)  # -2 never matches any code
+            b = v.build
+
+            def build(env, b=b, code=code, negate=negate):
+                eq = b(env) == code
+                return jnp.logical_not(eq) if negate else eq
+
+            return SVal(DT.BOOLEAN, build)
+        lv, rv = self.compile(lhs_e), self.compile(rhs_e)
+        if lv.dictionary is None or rv.dictionary is None:
+            raise CompilerError("string equality requires dictionary-encoded operands")
+        if lv.dictionary is rv.dictionary:
+            lb, rb = lv.build, rv.build
+
+            def build_same(env, lb=lb, rb=rb, negate=negate):
+                eq = lb(env) == rb(env)
+                return jnp.logical_not(eq) if negate else eq
+
+            return SVal(DT.BOOLEAN, build_same)
+        trans = rv.dictionary.translate_to(lv.dictionary, insert=False)
+        name = self._add_lut(trans)
+        lb, rb = lv.build, rv.build
+
+        def build_trans(env, lb=lb, rb=rb, name=name, negate=negate):
+            r = apply_lut(env["luts"][name], rb(env), -1)
+            eq = lb(env) == r
+            return jnp.logical_not(eq) if negate else eq
+
+        return SVal(DT.BOOLEAN, build_trans)
+
+    def _string_select(self, call: Call) -> SVal:
+        cond = self.compile(call.args[0])
+        a = self.compile(call.args[1])
+        b = self.compile(call.args[2])
+        if a.dictionary is None or b.dictionary is None:
+            raise CompilerError("select on strings requires dictionary operands")
+        # Output dictionary: copy of a's snapshot, then b's values appended.
+        out = Dictionary(a.dictionary.values())
+        tb = b.dictionary.translate_to(out, insert=True)
+        name = self._add_lut(tb)
+        cb, ab, bb = cond.build, a.build, b.build
+
+        def build(env, cb=cb, ab=ab, bb=bb, name=name):
+            bc = apply_lut(env["luts"][name], bb(env), -1)
+            return jnp.where(cb(env), ab(env), bc)
+
+        return SVal(DT.STRING, build, out)

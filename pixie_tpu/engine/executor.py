@@ -1,0 +1,828 @@
+"""Fragment executor: lowers a plan DAG to jitted batch kernels and runs it.
+
+This replaces the reference's push-based ExecutionGraph interpreter
+(src/carnot/exec/exec_graph.cc:177-295, exec_node.h Prepare/Open/Consume/Generate)
+with compilation: every maximal Source→(Map|Filter|Limit)*→(Agg|Sink) chain
+becomes ONE jitted function over fixed-shape padded batches.  Filters never
+compact on device — they refine a validity mask (XLA static shapes); compaction
+happens host-side at sinks.  Blocking aggregates carry a device-resident state
+pytree across batches (the streaming loop is host-driven), exactly the structure
+that later distributes: the same state merged over a mesh axis with collectives.
+
+Blocking operators (Agg finalize, Join, Union) materialize host batches; chains
+re-stream from those.  Joins/unions run host-side in numpy in v1 (they see small
+aggregated inputs in the target workloads); the device hash-join is a perf-phase
+upgrade tracked in SURVEY.md §7.
+
+Group-by strategy (see ops/groupby.py): every key must be reducible to a dense
+code — dictionary columns natively, raw int columns via a query-time dictionary
+built in a host pre-scan of the cursor snapshot, and `px.bin(time)`-derived
+window keys via range arithmetic. Anything else is rejected until the sort-based
+fallback lands.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.engine.eval import ExprCompiler, SVal, apply_lut
+from pixie_tpu.engine.result import QueryResult
+from pixie_tpu.plan.plan import (
+    AggOp,
+    Call,
+    Column,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    Literal,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    UnionOp,
+)
+from pixie_tpu.status import CompilerError, Internal, Unimplemented
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import STORAGE_DTYPE, ColumnSchema, DataType as DT, Relation
+
+from pixie_tpu.ops.groupby import next_pow2
+
+INT64_MIN = np.iinfo(np.int64).min
+INT64_MAX = np.iinfo(np.int64).max
+MAX_GROUPS = 1 << 22
+MIN_BUCKET = 1 << 10
+
+
+def _bucket(n: int, cap: int) -> int:
+    return min(max(next_pow2(n), MIN_BUCKET), max(cap, MIN_BUCKET))
+
+
+# --------------------------------------------------------------------- batches
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """Materialized intermediate (compacted, host numpy)."""
+
+    dtypes: dict[str, DT]
+    dicts: dict[str, Dictionary]
+    cols: dict[str, np.ndarray]
+
+    @property
+    def num_rows(self) -> int:
+        for v in self.cols.values():
+            return len(v)
+        return 0
+
+
+# ----------------------------------------------------------------- group keys
+
+
+@dataclasses.dataclass
+class GroupKey:
+    name: str
+    kind: str  # "dict" | "intdict" | "window"
+    card: int  # pow2-bucketed static cardinality
+    out_dtype: DT
+    dictionary: Optional[Dictionary] = None  # dict/intdict
+    #: source column the feed path reads for intdict encoding (differs from
+    #: `name` when a Map renamed the column).
+    src_name: str = ""
+    # window params
+    width: int = 0
+    t0_bin: int = 0
+    key_sval: Optional[SVal] = None  # device codes builder (dict/window)
+
+
+class _ChainCtx:
+    """Symbolic column environment threaded through a chain of transforms."""
+
+    def __init__(
+        self,
+        dtypes: dict[str, DT],
+        dicts: dict[str, Dictionary],
+        registry,
+        visible: Optional[list[str]] = None,
+    ):
+        self.sym: dict[str, SVal] = {}
+        self.provenance: dict[str, object] = {}
+        #: default output columns — the fed columns minus internals (e.g. a
+        #: time_ column fetched only to evaluate row-level time bounds).
+        self.visible: list[str] = list(visible) if visible is not None else list(dtypes)
+        self.registry = registry
+        self.ec = ExprCompiler(dtypes, dicts, registry)
+        # Seed with input columns.
+        for name, dt in dtypes.items():
+            self.sym[name] = self.ec.compile(Column(name))
+            self.provenance[name] = Column(name)
+        # Redirect column resolution to the evolving symbolic env.
+        self.ec._compile_column = self._resolve_column  # type: ignore[method-assign]
+
+    def _resolve_column(self, expr: Column) -> SVal:
+        v = self.sym.get(expr.name)
+        if v is None:
+            raise CompilerError(f"column {expr.name!r} not found; have {sorted(self.sym)}")
+        return v
+
+    def apply_map(self, op: MapOp):
+        new_sym = {}
+        new_prov = {}
+        for name, expr in op.exprs:
+            new_sym[name] = self.ec.compile(expr)
+            # Track one level of provenance for window-key detection, resolving
+            # pass-through renames to their origin.
+            if isinstance(expr, Column):
+                new_prov[name] = self.provenance.get(expr.name, expr)
+            else:
+                new_prov[name] = expr
+        self.ec._memo.clear()  # column meanings changed; don't reuse SVals
+        self.sym = new_sym
+        self.provenance = new_prov
+        self.visible = [n for n, _ in op.exprs]
+
+    def compile_predicate(self, op: FilterOp) -> SVal:
+        v = self.ec.compile(op.expr)
+        if v.dtype != DT.BOOLEAN:
+            raise CompilerError(f"filter expression has type {v.dtype.name}, want BOOLEAN")
+        return v
+
+
+# ---------------------------------------------------------------- chain kernel
+
+
+class ChainKernel:
+    """Compiles Source/HostBatch → transforms → (agg | output) into one jit fn."""
+
+    def __init__(
+        self,
+        in_dtypes: dict[str, DT],
+        in_dicts: dict[str, Dictionary],
+        transforms: list,
+        registry,
+        time_col: Optional[str],
+        visible: Optional[list[str]] = None,
+    ):
+        self.ctx = _ChainCtx(in_dtypes, in_dicts, registry, visible)
+        self.registry = registry
+        self.time_col = time_col
+        self.steps = []  # ("map", op) applied symbolically; ("filter", sval); ("limit",)
+        self.has_limit = False
+        for op in transforms:
+            if isinstance(op, MapOp):
+                self.ctx.apply_map(op)
+            elif isinstance(op, FilterOp):
+                self.steps.append(("filter", self.ctx.compile_predicate(op)))
+            elif isinstance(op, LimitOp):
+                self.steps.append(("limit", None))
+                self.has_limit = True
+            else:
+                raise Internal(f"non-streamable op {op.kind} in chain")
+
+    @property
+    def luts(self) -> dict[str, np.ndarray]:
+        return self.ctx.ec.luts
+
+    def _base_mask(self, env, n, n_valid, t_lo, t_hi):
+        mask = jnp.arange(n) < n_valid
+        if self.time_col is not None and self.time_col in env["cols"]:
+            t = env["cols"][self.time_col]
+            mask = mask & (t >= t_lo) & (t < t_hi)
+        return mask
+
+    def _apply_steps(self, env, mask, limit_remaining):
+        """Apply filter/limit steps. Returns (mask, limit_consumed).
+
+        limit_consumed counts the limit slots used by THIS batch — rows reaching
+        the (first) limit step, capped at the remaining budget.  It is what the
+        host must subtract from `remaining`: decrementing by the final output
+        count instead would let later batches emit rows past the limit whenever
+        a downstream filter drops limit-admitted rows.
+        """
+        consumed = jnp.int64(0)
+        seen_limit = False
+        for kind, sv in self.steps:
+            if kind == "filter":
+                mask = mask & sv.build(env)
+            else:  # limit
+                reaching = jnp.sum(mask.astype(jnp.int64))
+                mask = mask & (jnp.cumsum(mask.astype(jnp.int64)) <= limit_remaining)
+                if not seen_limit:
+                    consumed = jnp.minimum(reaching, limit_remaining)
+                    seen_limit = True
+        return mask, consumed
+
+    def make_output_step(self, out_names: list[str]):
+        """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts)
+        → (out_cols, mask, count). Also returns (dtypes, dicts) of outputs."""
+        sym = self.ctx.sym
+        missing = [n for n in out_names if n not in sym]
+        if missing:
+            raise CompilerError(f"output columns {missing} not found; have {sorted(sym)}")
+        out_dtypes = {n: sym[n].dtype for n in out_names}
+        out_dicts = {n: sym[n].dictionary for n in out_names if sym[n].dictionary is not None}
+        builders = [(n, sym[n].build) for n in out_names]
+
+        def step(cols, n_valid, t_lo, t_hi, limit_remaining, luts):
+            env = {"cols": cols, "luts": luts}
+            n = _first_len(cols)
+            mask = self._base_mask(env, n, n_valid, t_lo, t_hi)
+            mask, consumed = self._apply_steps(env, mask, limit_remaining)
+            outs = {}
+            for name, b in builders:
+                v = b(env)
+                outs[name] = jnp.broadcast_to(v, (n,)) if v.ndim == 0 else v
+            return outs, mask, jnp.sum(mask.astype(jnp.int64)), consumed
+
+        return jax.jit(step), out_dtypes, out_dicts
+
+    def make_agg_step(self, keys: list[GroupKey], udas: list, num_groups: int):
+        """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts, state)
+        → (state, count). udas: list of (out_name, UDA, value_builder|None)."""
+        from pixie_tpu.ops.groupby import combine_codes
+
+        key_builders = []
+        for k in keys:
+            if k.kind == "intdict":
+                pseudo = f"__qcode__{k.name}"
+                key_builders.append(lambda env, pseudo=pseudo: env["cols"][pseudo])
+            elif k.kind == "dict":
+                key_builders.append(k.key_sval.build)
+            else:  # window
+                sv = k.key_sval
+                w, t0 = k.width, k.t0_bin
+                key_builders.append(
+                    lambda env, sv=sv, w=w, t0=t0: (sv.build(env) // w - t0).astype(jnp.int32)
+                )
+        cards = [k.card for k in keys]
+
+        def step(cols, n_valid, t_lo, t_hi, limit_remaining, luts, state):
+            env = {"cols": cols, "luts": luts}
+            n = _first_len(cols)
+            mask = self._base_mask(env, n, n_valid, t_lo, t_hi)
+            mask, consumed = self._apply_steps(env, mask, limit_remaining)
+            if keys:
+                gid, _ = combine_codes([kb(env) for kb in key_builders], cards)
+            else:
+                gid = jnp.zeros(n, dtype=jnp.int32)
+            new_state = {}
+            for out_name, uda, vb in udas:
+                v = None
+                if vb is not None:
+                    v = vb(env)
+                    v = jnp.broadcast_to(v, (n,)) if v.ndim == 0 else v
+                new_state[out_name] = uda.update(state[out_name], gid, v, mask, num_groups)
+            return new_state, jnp.sum(mask.astype(jnp.int64)), consumed
+
+        # Kept unjitted for the SPMD lifter (parallel.spmd.spmd_agg_step wraps it
+        # in shard_map over a mesh axis).
+        self.raw_agg_step = step
+        return jax.jit(step, donate_argnums=(6,))
+
+
+def _first_len(cols: dict) -> int:
+    for v in cols.values():
+        return v.shape[0]
+    return 0
+
+
+# -------------------------------------------------------------------- executor
+
+
+class PlanExecutor:
+    def __init__(self, plan: Plan, table_store, registry=None):
+        from pixie_tpu.udf import registry as default_registry
+
+        self.plan = plan
+        self.store = table_store
+        self.registry = registry or default_registry
+        self._materialized: dict[int, HostBatch] = {}
+        self.stats = {"rows_scanned": 0, "rows_output": 0, "batches": 0, "compile_s": 0.0}
+
+    # ------------------------------------------------------------ plan walking
+    def _upstream_chain(self, op):
+        """Walk up through streamable transforms. Returns (head, [transforms...])."""
+        chain = []
+        cur = op
+        while isinstance(cur, (MapOp, FilterOp, LimitOp)):
+            chain.append(cur)
+            parents = self.plan.parents(cur)
+            if len(parents) != 1:
+                raise Internal(f"transform {cur.kind} must have exactly one parent")
+            cur = parents[0]
+        return cur, list(reversed(chain))
+
+    def _input_of(self, head):
+        """head is a Source or blocking op.
+
+        Returns (dtypes, dicts, src, feed_names, visible_names, time_col, cap).
+        feed_names may include a hidden time_ column fetched only so row-level
+        time bounds can be applied; visible_names excludes it.
+        """
+        if isinstance(head, MemorySourceOp):
+            table = self.store.table(head.table)
+            cursor = table.cursor(head.start_time, head.stop_time)
+            visible = list(head.columns or table.relation.names())
+            names = list(visible)
+            has_bounds = head.start_time is not None or head.stop_time is not None
+            if has_bounds and table.time_col is not None and table.time_col not in names:
+                names.append(table.time_col)
+            dtypes = {n: table.relation.dtype(n) for n in names}
+            dicts = {n: table.dictionaries[n] for n in names if n in table.dictionaries}
+            return dtypes, dicts, cursor, names, visible, table.time_col, table.batch_rows
+        hb = self._eval_blocking(head)
+        return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
+
+    # ------------------------------------------------------------- stream feed
+    def _feed(self, src, names, keys_intdict, cap):
+        """Yield (cols np dict padded, n_valid) host batches."""
+        if isinstance(src, HostBatch):
+            n = src.num_rows
+            # Materialized intermediates can exceed the stream cap (e.g. many
+            # groups out of an agg): bucket to their own pow2 size.
+            bucket = max(MIN_BUCKET, next_pow2(max(n, 1)))
+            cols = {k: _pad(src.cols[k], bucket) for k in names}
+            for gk in keys_intdict:
+                codes = gk.dictionary.encode(src.cols[gk.src_name])
+                cols[f"__qcode__{gk.name}"] = _pad(codes, bucket)
+            yield cols, n
+            return
+        for rb, _row_id, _gen in src:  # cursor
+            n = rb.num_valid
+            bucket = _bucket(rb.num_rows, cap)
+            cols = {k: _pad(rb.columns[k][: rb.num_rows], bucket) for k in names}
+            for gk in keys_intdict:
+                codes = gk.dictionary.encode(rb.columns[gk.src_name][:n])
+                cols[f"__qcode__{gk.name}"] = _pad(codes, bucket)
+            self.stats["rows_scanned"] += n
+            self.stats["batches"] += 1
+            yield cols, n
+
+    # ---------------------------------------------------------------- blocking
+    def _eval_blocking(self, op) -> HostBatch:
+        got = self._materialized.get(op.id)
+        if got is not None:
+            return got
+        if isinstance(op, AggOp):
+            out = self._run_agg(op)
+        elif isinstance(op, JoinOp):
+            out = self._run_join(op)
+        elif isinstance(op, UnionOp):
+            out = self._run_union(op)
+        elif isinstance(op, MemorySourceOp):
+            out = self._consume_to_batch(op, [])
+        else:
+            raise Internal(f"unexpected blocking op {op.kind}")
+        self._materialized[op.id] = out
+        return out
+
+    def _consume_chain(self, terminal_parent, out_names=None):
+        """Run the chain feeding `terminal_parent` through an output step.
+
+        Returns (out_dtypes, out_dicts, iterator of (np_cols, np_mask)).
+        """
+        head, chain = self._upstream_chain(terminal_parent)
+        dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
+        kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
+        if out_names is None:
+            out_names = list(kern.ctx.visible)
+        step, out_dtypes, out_dicts = kern.make_output_step(out_names)
+        t_lo, t_hi = _time_bounds(head)
+        luts = kern.luts
+        limit_total = _chain_limit(chain)
+        has_limit = limit_total < INT64_MAX
+
+        def gen():
+            remaining = limit_total
+            for cols, n_valid in self._feed(src, names, [], cap):
+                outs, mask, cnt, consumed = step(
+                    cols, np.int64(n_valid), t_lo, t_hi, np.int64(remaining), luts
+                )
+                cnt = int(cnt)
+                mask_np = np.asarray(mask)
+                yield {k: np.asarray(v)[mask_np] for k, v in outs.items()}, cnt
+                if has_limit:
+                    remaining -= int(consumed)
+                    if remaining <= 0:
+                        break
+
+        return out_dtypes, out_dicts, out_names, gen()
+
+    def _consume_to_batch(self, terminal_parent, out_names=None) -> HostBatch:
+        out_dtypes, out_dicts, out_names, gen = self._consume_chain(terminal_parent, out_names)
+        parts = [c for c, _ in gen]
+        cols = {
+            n: (
+                np.concatenate([p[n] for p in parts])
+                if parts
+                else np.empty(0, STORAGE_DTYPE[out_dtypes[n]])
+            )
+            for n in out_names
+        }
+        return HostBatch(out_dtypes, out_dicts, cols)
+
+    # --------------------------------------------------------------------- agg
+    def _plan_group_keys(self, op: AggOp, kern: ChainKernel, src, head) -> list[GroupKey]:
+        keys = []
+        for name in op.groups:
+            sv = kern.ctx.sym.get(name)
+            if sv is None:
+                raise CompilerError(f"group key {name!r} not found")
+            if sv.dictionary is not None:
+                keys.append(
+                    GroupKey(
+                        name,
+                        "dict",
+                        next_pow2(max(sv.dictionary.size, 1)),
+                        sv.dtype,
+                        sv.dictionary,
+                        key_sval=sv,
+                    )
+                )
+                continue
+            wk = _window_key(kern.ctx.provenance.get(name))
+            if wk is not None and sv.dtype in (DT.TIME64NS, DT.INT64):
+                width = wk
+                t_min, t_max = _source_time_range(src, head)
+                t0_bin = t_min // width
+                nbins = int(t_max // width - t0_bin) + 1
+                keys.append(
+                    GroupKey(
+                        name,
+                        "window",
+                        next_pow2(max(nbins, 1)),
+                        sv.dtype,
+                        width=width,
+                        t0_bin=int(t0_bin),
+                        key_sval=sv,
+                    )
+                )
+                continue
+            if sv.dtype in (DT.INT64, DT.TIME64NS, DT.BOOLEAN):
+                prov = kern.ctx.provenance.get(name)
+                if not isinstance(prov, Column):
+                    raise Unimplemented(
+                        f"group key {name!r} is a computed numeric column; only raw "
+                        "columns, dictionary columns and px.bin() windows can be "
+                        "grouped in this version"
+                    )
+                qd = Dictionary()
+                _prescan_unique(src, prov.name, qd)
+                keys.append(
+                    GroupKey(
+                        name,
+                        "intdict",
+                        next_pow2(max(qd.size, 1)),
+                        sv.dtype,
+                        qd,
+                        src_name=prov.name,
+                    )
+                )
+                continue
+            raise Unimplemented(f"cannot group by {name!r} of type {sv.dtype.name}")
+        total = 1
+        for k in keys:
+            total *= k.card
+        if total > MAX_GROUPS:
+            raise Unimplemented(
+                f"group cardinality bound {total} exceeds {MAX_GROUPS}; "
+                "high-cardinality group-by needs the sort-based path"
+            )
+        return keys
+
+    def _run_agg(self, op: AggOp) -> HostBatch:
+        head, chain = self._upstream_chain(self.plan.parents(op)[0])
+        dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
+        kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
+        keys = self._plan_group_keys(op, kern, src, head)
+        num_groups = 1
+        for k in keys:
+            num_groups *= k.card
+
+        # UDA instances + value builders (+ implicit row counter for seen-groups).
+        udas = []
+        state = {}
+        seen_name = "__seen"
+        from pixie_tpu.udf.udf import CountUDA
+
+        for ae in [*op.values]:
+            uda = self.registry.uda(ae.fn)
+            vb = None
+            in_dtype = None
+            if ae.arg is not None:
+                sv = kern.ctx.sym.get(ae.arg)
+                if sv is None:
+                    raise CompilerError(f"agg input column {ae.arg!r} not found")
+                if sv.dictionary is not None:
+                    raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
+                vb = sv.build
+                in_dtype = STORAGE_DTYPE[sv.dtype]
+            elif not uda.nullary:
+                raise CompilerError(f"aggregate {ae.fn} requires an input column")
+            udas.append((ae.out_name, uda, vb))
+            state[ae.out_name] = uda.init(num_groups, in_dtype)
+        seen_uda = CountUDA()
+        udas.append((seen_name, seen_uda, None))
+        state[seen_name] = seen_uda.init(num_groups)
+
+        step = kern.make_agg_step(keys, udas, num_groups)
+        t_lo, t_hi = _time_bounds(head)
+        luts = kern.luts
+        limit_total = _chain_limit(chain)
+        remaining = limit_total
+        has_limit = limit_total < INT64_MAX
+        intdict_keys = [k for k in keys if k.kind == "intdict"]
+        for cols, n_valid in self._feed(src, names, intdict_keys, cap):
+            state, cnt, consumed = step(
+                cols, np.int64(n_valid), t_lo, t_hi, np.int64(remaining), luts, state
+            )
+            # int(consumed) forces a device sync; only pay it when a limit is active.
+            if has_limit:
+                remaining -= int(consumed)
+                if remaining <= 0:
+                    break
+
+        state_np = jax.tree.map(np.asarray, state)
+        return self._finalize_agg(op, keys, udas, state_np, seen_name)
+
+    def _finalize_agg(self, op, keys, udas, state_np, seen_name) -> HostBatch:
+        from pixie_tpu.ops.groupby import split_codes
+
+        seen_counts = np.asarray(state_np[seen_name])
+        if keys:
+            gids = np.nonzero(seen_counts > 0)[0]
+        else:
+            gids = np.array([0])  # group-by-none always emits one row
+        dtypes: dict[str, DT] = {}
+        dicts: dict[str, Dictionary] = {}
+        cols: dict[str, np.ndarray] = {}
+        if keys:
+            codes = split_codes(gids, [k.card for k in keys])
+            for k, kc in zip(keys, codes):
+                dtypes[k.name] = k.out_dtype
+                if k.kind == "dict":
+                    cols[k.name] = kc.astype(np.int32)
+                    dicts[k.name] = k.dictionary
+                elif k.kind == "intdict":
+                    vals = k.dictionary.decode(kc)
+                    cols[k.name] = np.asarray(vals, dtype=STORAGE_DTYPE[k.out_dtype])
+                else:  # window
+                    cols[k.name] = ((kc.astype(np.int64) + k.t0_bin) * k.width).astype(
+                        np.int64
+                    )
+        for out_name, uda, _vb in udas:
+            if out_name == seen_name:
+                continue
+            full = uda.finalize_host(jax.tree.map(lambda x: x, state_np[out_name]))
+            vals = np.asarray(full)[gids]
+            out_dt = uda.out_type(None) if uda.nullary else uda.out_type(_dtype_of(full))
+            if out_dt == DT.STRING:
+                d = Dictionary()
+                cols[out_name] = d.encode(vals)
+                dicts[out_name] = d
+            else:
+                cols[out_name] = vals.astype(STORAGE_DTYPE[out_dt], copy=False)
+            dtypes[out_name] = out_dt
+        return HostBatch(dtypes, dicts, cols)
+
+    # -------------------------------------------------------------------- join
+    def _run_join(self, op: JoinOp) -> HostBatch:
+        parents = self.plan.parents(op)
+        if len(parents) != 2:
+            raise Internal("join needs two parents")
+        left = self._materialize_parent(parents[0])
+        right = self._materialize_parent(parents[1])
+        if len(op.left_on) != len(op.right_on) or not op.left_on:
+            raise CompilerError("join requires equal, non-empty key lists")
+
+        # Normalize keys to comparable numpy arrays (codes translated to the
+        # left dictionary space; raw values otherwise).
+        lkeys, rkeys = [], []
+        for lk, rk in zip(op.left_on, op.right_on):
+            lv, rv = left.cols[lk], right.cols[rk]
+            ld, rd = left.dicts.get(lk), right.dicts.get(rk)
+            if (ld is None) != (rd is None):
+                raise CompilerError(f"join key {lk}/{rk}: dictionary/plain mismatch")
+            if ld is not None and rd is not None and ld is not rd:
+                lut = rd.translate_to(ld, insert=False)
+                rv = np.where(rv >= 0, lut[np.clip(rv, 0, max(len(lut) - 1, 0))], -1) if len(lut) else np.full_like(rv, -1)
+            lkeys.append(lv)
+            rkeys.append(rv)
+
+        # Host hash join via sorted unique composite keys.
+        lcomp = _composite(lkeys)
+        rcomp = _composite(rkeys)
+        uniq, linv = np.unique(lcomp, return_inverse=True)
+        ridx = np.searchsorted(uniq, rcomp)
+        ridx_c = np.clip(ridx, 0, max(len(uniq) - 1, 0))
+        found = (len(uniq) > 0) & (uniq[ridx_c] == rcomp) if len(uniq) else np.zeros(len(rcomp), bool)
+        # Build: last row per key wins (duplicate build keys collapse; the
+        # many-to-many expansion is the sort-merge upgrade).
+        build_row = np.full(len(uniq), -1, dtype=np.int64)
+        build_row[linv] = np.arange(len(lcomp))
+        bidx = np.where(found, build_row[ridx_c], -1)
+
+        keep = bidx >= 0
+        if op.how == "inner":
+            rsel = np.nonzero(keep)[0]
+        elif op.how in ("right", "left_outer_probe"):
+            rsel = np.arange(len(rcomp))
+        else:
+            raise Unimplemented(f"join how={op.how!r} (inner/right supported)")
+        bsel = bidx[rsel]
+
+        dtypes, dicts, cols = {}, {}, {}
+        outputs = op.output or _default_join_output(left, right)
+        for side, col, out_name in outputs:
+            if side == "left":
+                src_b, arr = left, left.cols[col]
+                take = np.clip(bsel, 0, max(len(arr) - 1, 0))
+                v = arr[take] if len(arr) else np.zeros(len(bsel), arr.dtype)
+                miss = bsel < 0
+                if miss.any():
+                    v = v.copy()
+                    v[miss] = _null_value(src_b.dtypes[col])
+            else:
+                src_b, arr = right, right.cols[col]
+                v = arr[rsel]
+            dtypes[out_name] = src_b.dtypes[col]
+            if col in src_b.dicts:
+                dicts[out_name] = src_b.dicts[col]
+            cols[out_name] = v
+        return HostBatch(dtypes, dicts, cols)
+
+    def _run_union(self, op: UnionOp) -> HostBatch:
+        parents = self.plan.parents(op)
+        batches = [self._materialize_parent(p) for p in parents]
+        first = batches[0]
+        cols: dict[str, np.ndarray] = {}
+        dicts: dict[str, Dictionary] = {}
+        for name, dt in first.dtypes.items():
+            parts = []
+            if name in first.dicts:
+                target = Dictionary(first.dicts[name].values())
+                dicts[name] = target
+                for b in batches:
+                    lut = b.dicts[name].translate_to(target, insert=True)
+                    c = b.cols[name]
+                    parts.append(
+                        np.where(c >= 0, lut[np.clip(c, 0, max(len(lut) - 1, 0))], -1)
+                        if len(lut)
+                        else c
+                    )
+            else:
+                parts = [b.cols[name] for b in batches]
+            cols[name] = np.concatenate(parts) if parts else np.empty(0)
+        return HostBatch(dict(first.dtypes), dicts, cols)
+
+    def _materialize_parent(self, parent) -> HostBatch:
+        head, chain = self._upstream_chain(parent)
+        if not chain and not isinstance(head, MemorySourceOp):
+            return self._eval_blocking(head)
+        return self._consume_to_batch(parent)
+
+    # -------------------------------------------------------------------- run
+    def run(self) -> dict[str, QueryResult]:
+        results = {}
+        for sink in self.plan.sinks():
+            if not isinstance(sink, MemorySinkOp):
+                raise Internal(f"plan sink {sink.kind} is not a MemorySink")
+            parent = self.plan.parents(sink)[0]
+            out_dtypes, out_dicts, out_names, gen = self._consume_chain(
+                parent, sink.columns
+            )
+            parts = [c for c, _ in gen]
+            cols = {
+                n: (
+                    np.concatenate([p[n] for p in parts])
+                    if parts
+                    else np.empty(0, STORAGE_DTYPE[out_dtypes[n]])
+                )
+                for n in out_names
+            }
+            rel = Relation([ColumnSchema(n, out_dtypes[n]) for n in out_names])
+            nrows = len(next(iter(cols.values()))) if cols else 0
+            self.stats["rows_output"] += nrows
+            results[sink.name] = QueryResult(
+                name=sink.name,
+                relation=rel,
+                columns=cols,
+                dictionaries={n: d for n, d in out_dicts.items()},
+                exec_stats=dict(self.stats),
+            )
+        return results
+
+
+# --------------------------------------------------------------------- helpers
+
+
+def _pad(arr: np.ndarray, n: int) -> np.ndarray:
+    if len(arr) == n:
+        return arr
+    out = np.zeros(n, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def _time_bounds(head) -> tuple[np.int64, np.int64]:
+    if isinstance(head, MemorySourceOp):
+        lo = INT64_MIN if head.start_time is None else int(head.start_time)
+        hi = INT64_MAX if head.stop_time is None else int(head.stop_time)
+        return np.int64(lo), np.int64(hi)
+    return np.int64(INT64_MIN), np.int64(INT64_MAX)
+
+
+def _chain_limit(chain) -> int:
+    lim = INT64_MAX
+    for op in chain:
+        if isinstance(op, LimitOp):
+            lim = min(lim, int(op.n))
+    return lim
+
+
+def _window_key(expr) -> Optional[int]:
+    """Detect Call(bin, (time-ish, Literal w)) → window width, else None."""
+    if isinstance(expr, Call) and expr.fn == "bin" and len(expr.args) == 2:
+        w = expr.args[1]
+        if isinstance(w, Literal) and isinstance(w.value, int) and w.value > 0:
+            return int(w.value)
+    return None
+
+
+def _source_time_range(src, head) -> tuple[int, int]:
+    t_min, t_max = None, None
+    if isinstance(src, HostBatch):
+        raise Unimplemented("window group keys require a table source")
+    for rb, _rid, _gen in src:
+        tc = src.table.time_col
+        if tc is None:
+            raise Unimplemented("window group keys require a time_ column")
+        t = rb.columns[tc][: rb.num_valid]
+        if len(t):
+            mn, mx = int(t.min()), int(t.max())
+            t_min = mn if t_min is None else min(t_min, mn)
+            t_max = mx if t_max is None else max(t_max, mx)
+    if t_min is None:
+        t_min, t_max = 0, 0
+    if isinstance(head, MemorySourceOp):
+        if head.start_time is not None:
+            t_min = max(t_min, int(head.start_time))
+        if head.stop_time is not None:
+            t_max = min(t_max, int(head.stop_time) - 1)
+    return t_min, max(t_min, t_max)
+
+
+def _prescan_unique(src, col: str, qd: Dictionary):
+    if isinstance(src, HostBatch):
+        qd.encode(src.cols[col])
+        return
+    for rb, _rid, _gen in src:
+        arr = rb.columns[col][: rb.num_valid]
+        if len(arr):
+            qd.encode(np.unique(arr))
+
+
+def _composite(keys: list[np.ndarray]) -> np.ndarray:
+    """Combine key arrays into one comparable array (structured dtype)."""
+    if len(keys) == 1:
+        return keys[0]
+    rec = np.rec.fromarrays(keys)
+    return rec
+
+
+def _default_join_output(left: HostBatch, right: HostBatch):
+    out = []
+    for c in right.cols:
+        out.append(("right", c, c))
+    for c in left.cols:
+        if c not in right.cols:
+            out.append(("left", c, c))
+    return out
+
+
+def _null_value(dt: DT):
+    if dt == DT.FLOAT64:
+        return np.nan
+    if dt in (DT.STRING, DT.UINT128):
+        return -1  # code -1 decodes to None
+    return 0
+
+
+def _dtype_of(arr) -> DT:
+    d = np.asarray(arr).dtype
+    if d.kind == "f":
+        return DT.FLOAT64
+    if d.kind in "iu":
+        return DT.INT64
+    if d.kind == "b":
+        return DT.BOOLEAN
+    return DT.STRING
+
+
+def execute_plan(plan: Plan, table_store, registry=None) -> dict[str, QueryResult]:
+    """Compile + run a plan against a table store; returns {sink_name: QueryResult}."""
+    return PlanExecutor(plan, table_store, registry).run()

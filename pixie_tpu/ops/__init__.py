@@ -1,0 +1,19 @@
+"""Device kernel library — the TPU equivalent of Carnot's exec operators
+(reference src/carnot/exec/).
+
+Everything here is pure-functional JAX over fixed-shape tensors:
+
+  * Batches are dicts of equal-length device arrays plus a validity `mask`
+    (padding + filtered rows are masked, never compacted on device — dynamic
+    shapes would defeat XLA).
+  * Group-by uses dense group codes (dictionary codes, mixed-radix combined),
+    lowered to `segment_*` reductions — no hash tables on device.
+  * Aggregate state is a pytree whose leaves each declare a reduction op
+    ("add"/"min"/"max"), so partial→final distributed aggregation is a direct
+    psum/pmin/pmax over a mesh axis (replaces the reference's serialize-UDA-state
+    → gRPC → Merge path, planpb/plan.proto:250-257).
+"""
+from pixie_tpu.ops.groupby import combine_codes, split_codes, masked_segment_sum
+from pixie_tpu.ops.sketch import LogHistogram
+
+__all__ = ["combine_codes", "split_codes", "masked_segment_sum", "LogHistogram"]

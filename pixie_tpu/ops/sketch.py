@@ -1,0 +1,83 @@
+"""Mergeable quantile sketch as a dense tensor.
+
+Replaces the reference's t-digest UDA (src/carnot/funcs/builtins/math_sketches.h:34-49)
+whose pointer-based centroid structure cannot live on a TPU. We use a DDSketch-style
+log-bucketed histogram: fixed relative accuracy, fixed memory, and — crucially —
+merge is elementwise addition, so distributed merge of per-device partial sketches
+is a single `psum` over the mesh axis.
+
+Layout per group: float32[NBINS + 2] — bin 0 counts values <= 0 ("zero bin"),
+bins 1..NBINS count positive values by ceil(log_gamma(v)); the last bin absorbs
+overflow. With gamma = 1.02 and 1024 bins the dynamic range is ~1e8 at 2% relative
+error, which covers latency-in-ns style telemetry after scaling.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LogHistogram:
+    nbins: int = 1024
+    gamma: float = 1.02
+    #: values below this are counted in the zero bin.
+    min_value: float = 1e-9
+
+    @property
+    def width(self) -> int:
+        return self.nbins + 2
+
+    def _log_gamma(self):
+        return math.log(self.gamma)
+
+    def bin_index(self, v: jax.Array) -> jax.Array:
+        """Bin index per value (device)."""
+        lg = jnp.log(jnp.maximum(v.astype(jnp.float32), self.min_value)) / self._log_gamma()
+        idx = jnp.ceil(lg).astype(jnp.int32) + 1  # +1: bin 0 is the zero bin
+        idx = jnp.where(v <= self.min_value, 0, idx)
+        return jnp.clip(idx, 0, self.width - 1)
+
+    def update(
+        self,
+        hist: jax.Array,  # [num_groups, width]
+        gid: jax.Array,
+        values: jax.Array,
+        mask: jax.Array,
+        num_groups: int,
+    ) -> jax.Array:
+        """Scatter-add values into per-group histograms via one flat segment_sum."""
+        flat_idx = gid.astype(jnp.int32) * self.width + self.bin_index(values)
+        ones = jnp.where(mask, 1.0, 0.0).astype(hist.dtype)
+        add = jax.ops.segment_sum(ones, flat_idx, num_segments=num_groups * self.width)
+        return hist + add.reshape(num_groups, self.width)
+
+    def init(self, num_groups: int, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros((num_groups, self.width), dtype=dtype)
+
+    # merge == elementwise add (psum-compatible); no method needed.
+
+    def bin_value(self, idx: np.ndarray) -> np.ndarray:
+        """Representative value of a bin (host): geometric mean of bin bounds."""
+        i = np.asarray(idx, dtype=np.float64) - 1.0
+        val = np.power(self.gamma, i - 0.5)
+        return np.where(np.asarray(idx) <= 0, 0.0, val)
+
+    def quantile(self, hist: np.ndarray, qs: list[float]) -> np.ndarray:
+        """Host-side finalize: quantiles per group. hist: [G, width] → [G, len(qs)]."""
+        h = np.asarray(hist, dtype=np.float64)
+        totals = h.sum(axis=-1, keepdims=True)
+        cum = np.cumsum(h, axis=-1)
+        out = np.empty((h.shape[0], len(qs)), dtype=np.float64)
+        for j, q in enumerate(qs):
+            target = np.clip(q, 0.0, 1.0) * totals[:, 0]
+            # Per-row searchsorted: first bin where cum >= target.
+            idx = (cum < target[:, None]).sum(axis=-1)
+            idx = np.minimum(idx, h.shape[1] - 1)
+            out[:, j] = self.bin_value(idx)
+        out[totals[:, 0] == 0] = np.nan
+        return out

@@ -1,0 +1,8 @@
+from pixie_tpu.parallel.spmd import (
+    collective_merge,
+    make_mesh,
+    reduce_tree_for,
+    spmd_agg_step,
+)
+
+__all__ = ["make_mesh", "collective_merge", "spmd_agg_step", "reduce_tree_for"]

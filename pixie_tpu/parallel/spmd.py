@@ -1,0 +1,104 @@
+"""SPMD distributed aggregation over a device mesh.
+
+The TPU-native replacement for the reference's distributed plan fan-out
+(SURVEY.md §2.5): where Pixie replicates a plan fragment per PEM and merges
+serialized UDA state over gRPC (planpb partial_agg/finalize_results,
+plan.proto:250-257; splitter/partial_op_mgr), we run the SAME fragment kernel as
+an SPMD program over a `jax.sharding.Mesh` axis ("agents" — the PEM analog) and
+merge aggregate state *inside* the jitted program with XLA collectives riding
+ICI: psum for additive state, pmin/pmax for extremal state.  Because every UDA
+declares per-leaf reduce ops (see udf.udf.UDA), the collective merge is derived
+mechanically — no per-UDA serialization code.
+
+Correctness requirement: UDA init states must be reduction identities (zeros for
+add, ±inf for min/max) — they are — since each device starts from the same
+replicated init and contributes only its shard's rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+AGENT_AXIS = "agents"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = AGENT_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"need {n_devices} devices, have {len(devs)} ({devs[0].platform})"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def reduce_tree_for(udas: list) -> dict:
+    """State-structure-matching tree of reduce ops for a list of
+    (out_name, UDA, value_builder) triples (the executor's agg spec)."""
+    return {name: uda.reduce_ops() for name, uda, _vb in udas}
+
+
+_COLLECTIVE = {"add": lax.psum, "min": lax.pmin, "max": lax.pmax}
+
+
+def collective_merge(state, reduce_tree, axis_name: str):
+    """Merge per-device partial agg states across a mesh axis."""
+    return jax.tree.map(
+        lambda op, x: _COLLECTIVE[op](x, axis_name), reduce_tree, state,
+        is_leaf=lambda x: isinstance(x, str),
+    )
+
+
+def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
+    """Lift a single-device agg step into an SPMD step over `mesh`.
+
+    raw_step(cols, n_valid, t_lo, t_hi, limit, luts, state) -> (state, count)
+    is the UNJITTED kernel from ChainKernel.make_agg_step (each device sees its
+    local shard).  The lifted step takes:
+      cols        — leading dim sharded over `axis` ([n_dev, rows_per_dev, ...])
+      n_valid     — int64[n_dev], per-shard valid counts
+      state       — replicated identity-initialized state
+    and returns the MERGED (replicated) state plus the global passed-row count.
+    """
+
+    def local(cols, n_valid, t_lo, t_hi, limit, luts, state):
+        # shard_map hands us local blocks with the sharded leading axis of size 1.
+        cols = jax.tree.map(lambda x: x[0], cols)
+        nv = n_valid[0]
+        new_state, cnt, _consumed = raw_step(cols, nv, t_lo, t_hi, limit, luts, state)
+        merged = collective_merge(new_state, reduce_tree, axis)
+        total = lax.psum(cnt, axis)
+        return merged, total
+
+    shard = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(shard)
+
+
+def shard_batches(cols: dict, n_devices: int) -> dict:
+    """Host helper: split padded columns into [n_dev, rows/n_dev] blocks.
+
+    Rows must already be padded to a multiple of n_devices. Pair with
+    `per_shard_valid` for the matching per-shard valid counts.
+    """
+    out = {}
+    for k, v in cols.items():
+        n = len(v)
+        assert n % n_devices == 0, f"{k}: {n} rows not divisible by {n_devices}"
+        out[k] = v.reshape(n_devices, n // n_devices)
+    return out
+
+
+def per_shard_valid(n_valid: int, total_rows: int, n_devices: int) -> np.ndarray:
+    """Valid counts per shard for a prefix-valid padded batch split row-major."""
+    per = total_rows // n_devices
+    starts = np.arange(n_devices) * per
+    return np.clip(n_valid - starts, 0, per).astype(np.int64)

@@ -1,0 +1,9 @@
+from pixie_tpu.udf.udf import UDA, ScalarUDF, Registry
+from pixie_tpu.udf import builtins as _builtins
+
+#: Process-global registry preloaded with builtins (reference carnot registers
+#: funcs/ builtins into the Registry at startup, src/carnot/funcs/funcs.cc).
+registry = Registry()
+_builtins.register_all(registry)
+
+__all__ = ["UDA", "ScalarUDF", "Registry", "registry"]

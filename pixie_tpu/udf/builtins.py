@@ -1,0 +1,132 @@
+"""Builtin scalar UDFs and UDAs.
+
+Parity targets: reference src/carnot/funcs/builtins/{math_ops.cc, string_ops.cc,
+conditionals.cc, math_sketches.h, json_ops.cc, ...} (~300 builtins).  Device
+numeric functions are jax-traced and fuse into the fragment kernel; string
+functions are host functions evaluated over dictionary values (O(unique)).
+Metadata functions (upid_to_pod_name, ...) are registered separately by
+pixie_tpu.metadata when a metadata state is attached.
+"""
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+
+from pixie_tpu.types import DataType as DT
+from pixie_tpu.udf.udf import (
+    CountUDA,
+    MaxUDA,
+    MeanUDA,
+    MinUDA,
+    QuantileUDA,
+    QuantilesUDA,
+    Registry,
+    ScalarUDF,
+    SumUDA,
+)
+
+_B, _I, _F, _S, _T = DT.BOOLEAN, DT.INT64, DT.FLOAT64, DT.STRING, DT.TIME64NS
+
+
+def _dev(name, args, out, fn):
+    return ScalarUDF(name=name, arg_types=tuple(args), out_type=out, fn=fn, device=True)
+
+
+def _host(name, args, out, fn, const_args=0):
+    return ScalarUDF(
+        name=name, arg_types=tuple(args), out_type=out, fn=fn, device=False, const_args=const_args
+    )
+
+
+def register_all(r: Registry) -> None:
+    # ---------------------------------------------------------------- numeric
+    for args in ((_I, _I), (_F, _F)):
+        out = args[0]
+        r.register(_dev("add", args, out, lambda a, b: a + b))
+        r.register(_dev("subtract", args, out, lambda a, b: a - b))
+        r.register(_dev("multiply", args, out, lambda a, b: a * b))
+        r.register(_dev("modulo", args, out, lambda a, b: jnp.where(b != 0, a % jnp.where(b == 0, 1, b), 0)))
+    # Division always yields float (PxL / Python semantics).
+    r.register(_dev("divide", (_F, _F), _F, lambda a, b: a.astype(jnp.float64) / b))
+    r.register(_dev("floordiv", (_I, _I), _I, lambda a, b: jnp.where(b != 0, a // jnp.where(b == 0, 1, b), 0)))
+    r.register(_dev("pow", (_F, _F), _F, lambda a, b: jnp.power(a.astype(jnp.float64), b)))
+    r.register(_dev("abs", (_F,), _F, jnp.abs))
+    r.register(_dev("abs", (_I,), _I, jnp.abs))
+    r.register(_dev("log", (_F,), _F, jnp.log))
+    r.register(_dev("log2", (_F,), _F, jnp.log2))
+    r.register(_dev("log10", (_F,), _F, jnp.log10))
+    r.register(_dev("exp", (_F,), _F, jnp.exp))
+    r.register(_dev("sqrt", (_F,), _F, jnp.sqrt))
+    r.register(_dev("ceil", (_F,), _F, lambda a: jnp.ceil(a)))
+    r.register(_dev("floor", (_F,), _F, lambda a: jnp.floor(a)))
+    r.register(_dev("round", (_F,), _F, lambda a: jnp.round(a)))
+    # time binning: px.bin(t, size) — truncate to window start
+    r.register(_dev("bin", (_T, _I), _T, lambda t, s: t - t % jnp.where(s == 0, 1, s)))
+    r.register(_dev("bin", (_I, _I), _I, lambda t, s: t - t % jnp.where(s == 0, 1, s)))
+
+    # ------------------------------------------------------------ comparisons
+    for args in ((_I, _I), (_F, _F), (_B, _B), (_T, _T)):
+        r.register(_dev("equal", args, _B, lambda a, b: a == b))
+        r.register(_dev("not_equal", args, _B, lambda a, b: a != b))
+    for args in ((_I, _I), (_F, _F), (_T, _T)):
+        r.register(_dev("less", args, _B, lambda a, b: a < b))
+        r.register(_dev("less_equal", args, _B, lambda a, b: a <= b))
+        r.register(_dev("greater", args, _B, lambda a, b: a > b))
+        r.register(_dev("greater_equal", args, _B, lambda a, b: a >= b))
+
+    # ----------------------------------------------------------------- logical
+    r.register(_dev("logical_and", (_B, _B), _B, jnp.logical_and))
+    r.register(_dev("logical_or", (_B, _B), _B, jnp.logical_or))
+    r.register(_dev("logical_not", (_B,), _B, jnp.logical_not))
+
+    # ------------------------------------------------------------ conditionals
+    # select on numerics is a device where(); select on strings is handled by the
+    # evaluator via code translation (reference builtins/conditionals.cc).
+    for t in (_I, _F, _B, _T):
+        r.register(_dev("select", (_B, t, t), t, lambda c, a, b: jnp.where(c, a, b)))
+
+    # ------------------------------------------------------------ string (host)
+    r.register(_host("length", (_S,), _I, lambda s: len(s)))
+    r.register(_host("contains", (_S, _S), _B, lambda s, sub: sub in s, const_args=1))
+    r.register(_host("find", (_S, _S), _I, lambda s, sub: s.find(sub), const_args=1))
+    r.register(_host("to_upper", (_S,), _S, lambda s: s.upper()))
+    r.register(_host("to_lower", (_S,), _S, lambda s: s.lower()))
+    r.register(_host("trim", (_S,), _S, lambda s: s.strip()))
+    r.register(
+        _host(
+            "substring",
+            (_S, _I, _I),
+            _S,
+            lambda s, start, length: s[start : start + length],
+            const_args=2,
+        )
+    )
+    r.register(
+        _host(
+            "regex_match",
+            (_S, _S),
+            _B,
+            lambda s, pattern: re.fullmatch(pattern, s) is not None,
+            const_args=1,
+        )
+    )
+    r.register(
+        _host(
+            "regex_replace",
+            (_S, _S, _S),
+            _S,
+            lambda s, pattern, repl: re.sub(pattern, repl, s),
+            const_args=2,
+        )
+    )
+
+    # -------------------------------------------------------------------- UDAs
+    r.register_uda("count", CountUDA)
+    r.register_uda("sum", SumUDA)
+    r.register_uda("mean", MeanUDA)
+    r.register_uda("min", MinUDA)
+    r.register_uda("max", MaxUDA)
+    r.register_uda("quantiles", QuantilesUDA)
+    for q in (0.01, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99):
+        r.register_uda(f"p{int(round(q*100)):02d}", (lambda q=q: QuantileUDA(q)))

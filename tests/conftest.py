@@ -3,14 +3,22 @@
 Mirrors the reference's "every distributed behavior has an in-process seam"
 strategy (SURVEY.md §4): all tests run on CPU with 8 virtual XLA devices so
 mesh/collective paths are exercised without TPU hardware.
+
+NOTE: this environment's sitecustomize registers an `axon` TPU platform and
+programmatically sets jax_platforms="axon,cpu" — env vars like JAX_PLATFORMS=cpu
+are overridden.  The only reliable way to force CPU is jax.config.update BEFORE
+any backend initialization, which is why it happens here at conftest import.
 """
 import os
 
-# Must be set before jax import.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

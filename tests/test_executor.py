@@ -1,0 +1,388 @@
+"""End-to-end plan execution vs pandas oracle.
+
+Parity target: reference CarnotTest (src/carnot/carnot_test.cc:43) which runs full
+queries against in-memory tables in-process. Shapes are kept uniform across tests
+(batch_rows=2048) to share XLA compilations.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.plan import (
+    AggExpr,
+    AggOp,
+    Call,
+    Column,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    Literal,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    UnionOp,
+    lit,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+N = 5000
+BATCH_ROWS = 2048
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(7)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS),
+        ("service", DT.STRING),
+        ("req_path", DT.STRING),
+        ("latency", DT.FLOAT64),
+        ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=BATCH_ROWS)
+    t.write(
+        {
+            "time_": np.arange(N, dtype=np.int64) * 1000,
+            "service": rng.choice(["cart", "checkout", "frontend", "payments"], N).tolist(),
+            "req_path": rng.choice(["/api/v1/a", "/api/v1/b", "/healthz"], N).tolist(),
+            "latency": rng.exponential(50.0, N),
+            "status": rng.choice([200, 404, 500], N, p=[0.8, 0.1, 0.1]),
+        }
+    )
+    return ts
+
+
+@pytest.fixture(scope="module")
+def df(store):
+    t = store.table("http_events")
+    frames = []
+    for rb, _, _ in t.cursor():
+        d = {}
+        for c in t.relation:
+            arr = rb.columns[c.name][: rb.num_valid]
+            if c.name in t.dictionaries:
+                d[c.name] = t.dictionaries[c.name].decode(arr)
+            else:
+                d[c.name] = arr
+        frames.append(pd.DataFrame(d))
+    return pd.concat(frames, ignore_index=True)
+
+
+def run(plan, store):
+    return execute_plan(plan, store)["output"]
+
+
+class TestScanProject:
+    def test_full_scan(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        p.add(MemorySinkOp(name="output"), parents=[src])
+        out = run(p, store)
+        assert out.num_rows == N
+        pd.testing.assert_frame_equal(
+            out.to_pandas(), df, check_dtype=False
+        )
+
+    def test_time_bounds_row_level(self, store, df):
+        lo, hi = 1_000_000, 3_000_000
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events", start_time=lo, stop_time=hi))
+        p.add(MemorySinkOp(name="output"), parents=[src])
+        out = run(p, store)
+        expect = df[(df.time_ >= lo) & (df.time_ < hi)]
+        assert out.num_rows == len(expect)
+
+    def test_map_compute(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        m = p.add(
+            MapOp(
+                exprs=[
+                    ("latency_ms", Call("divide", (Column("latency"), lit(1000.0)))),
+                    ("ok", Call("equal", (Column("status"), lit(200)))),
+                    ("service", Column("service")),
+                ]
+            ),
+            parents=[src],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[m])
+        out = run(p, store)
+        got = out.to_pandas()
+        np.testing.assert_allclose(got.latency_ms, df.latency / 1000.0)
+        np.testing.assert_array_equal(got.ok, df.status == 200)
+        assert got.service.tolist() == df.service.tolist()
+
+    def test_limit(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        f = p.add(
+            FilterOp(expr=Call("equal", (Column("status"), lit(500)))), parents=[src]
+        )
+        l = p.add(LimitOp(n=17), parents=[f])
+        p.add(MemorySinkOp(name="output"), parents=[l])
+        out = run(p, store)
+        assert out.num_rows == 17
+        expect = df[df.status == 500].head(17)
+        np.testing.assert_array_equal(out.to_pandas().time_, expect.time_)
+
+
+class TestFilter:
+    def test_numeric_and_string_filter(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        f1 = p.add(
+            FilterOp(expr=Call("equal", (Column("status"), lit(200)))), parents=[src]
+        )
+        f2 = p.add(
+            FilterOp(expr=Call("equal", (Column("service"), lit("cart")))), parents=[f1]
+        )
+        p.add(MemorySinkOp(name="output"), parents=[f2])
+        out = run(p, store)
+        expect = df[(df.status == 200) & (df.service == "cart")]
+        assert out.num_rows == len(expect)
+        assert set(out.decoded("service")) == {"cart"}
+
+    def test_contains_host_udf(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        f = p.add(
+            FilterOp(expr=Call("contains", (Column("req_path"), lit("api")))),
+            parents=[src],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[f])
+        out = run(p, store)
+        expect = df[df.req_path.str.contains("api")]
+        assert out.num_rows == len(expect)
+
+
+class TestAgg:
+    def test_groupby_count_http_data_shape(self, store, df):
+        """BASELINE config #1: filter + groupby(service,status) + count."""
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        f = p.add(
+            FilterOp(expr=Call("not_equal", (Column("service"), lit("")))), parents=[src]
+        )
+        agg = p.add(
+            AggOp(
+                groups=["service", "status"],
+                values=[AggExpr("cnt", "count", None)],
+            ),
+            parents=[f],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[agg])
+        out = run(p, store)
+        got = out.to_pandas().sort_values(["service", "status"]).reset_index(drop=True)
+        expect = (
+            df.groupby(["service", "status"], as_index=False)
+            .size()
+            .rename(columns={"size": "cnt"})
+            .sort_values(["service", "status"])
+            .reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_agg_sum_mean_min_max(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg = p.add(
+            AggOp(
+                groups=["service"],
+                values=[
+                    AggExpr("total", "sum", "latency"),
+                    AggExpr("avg", "mean", "latency"),
+                    AggExpr("lo", "min", "latency"),
+                    AggExpr("hi", "max", "latency"),
+                ],
+            ),
+            parents=[src],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[agg])
+        out = run(p, store)
+        got = out.to_pandas().sort_values("service").reset_index(drop=True)
+        expect = (
+            df.groupby("service", as_index=False)
+            .agg(total=("latency", "sum"), avg=("latency", "mean"),
+                 lo=("latency", "min"), hi=("latency", "max"))
+            .sort_values("service")
+            .reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(got, expect, check_dtype=False)
+
+    def test_groupby_none(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg = p.add(
+            AggOp(groups=[], values=[AggExpr("cnt", "count", None)]), parents=[src]
+        )
+        p.add(MemorySinkOp(name="output"), parents=[agg])
+        out = run(p, store)
+        assert out.num_rows == 1
+        assert out.columns["cnt"][0] == N
+
+    def test_windowed_quantile(self, store, df):
+        """BASELINE config #2 shape: time-windowed p50/p99 per service."""
+        w = 1_000_000  # 1ms windows over the synthetic 1us-spaced times
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        m = p.add(
+            MapOp(
+                exprs=[
+                    ("ts", Call("bin", (Column("time_"), Literal(w, DT.INT64)))),
+                    ("service", Column("service")),
+                    ("latency", Column("latency")),
+                ]
+            ),
+            parents=[src],
+        )
+        agg = p.add(
+            AggOp(
+                groups=["ts", "service"],
+                values=[AggExpr("p50", "p50", "latency"), AggExpr("cnt", "count", None)],
+            ),
+            parents=[m],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[agg])
+        out = run(p, store)
+        got = out.to_pandas().sort_values(["ts", "service"]).reset_index(drop=True)
+        ex = df.assign(ts=(df.time_ // w) * w)
+        expect = (
+            ex.groupby(["ts", "service"], as_index=False)
+            .agg(p50=("latency", "median"), cnt=("latency", "size"))
+            .sort_values(["ts", "service"])
+            .reset_index(drop=True)
+        )
+        assert got[["ts", "service", "cnt"]].equals(expect[["ts", "service", "cnt"]])
+        np.testing.assert_allclose(got.p50, expect.p50, rtol=0.10)
+
+    def test_post_agg_map_filter(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        agg = p.add(
+            AggOp(groups=["service"], values=[AggExpr("cnt", "count", None)]),
+            parents=[src],
+        )
+        m = p.add(
+            MapOp(
+                exprs=[
+                    ("service", Column("service")),
+                    ("double_cnt", Call("multiply", (Column("cnt"), lit(2)))),
+                ]
+            ),
+            parents=[agg],
+        )
+        f = p.add(
+            FilterOp(expr=Call("greater", (Column("double_cnt"), lit(2000)))),
+            parents=[m],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[f])
+        out = run(p, store)
+        expect = df.groupby("service").size() * 2
+        expect = expect[expect > 2000]
+        got = out.to_pandas().set_index("service").double_cnt
+        assert got.sort_index().to_dict() == expect.sort_index().to_dict()
+
+
+class TestJoinUnion:
+    def test_join_agg_tables(self, store, df):
+        """net_flow_graph shape: join two aggregates on service."""
+        p = Plan()
+        src1 = p.add(MemorySourceOp(table="http_events"))
+        agg1 = p.add(
+            AggOp(groups=["service"], values=[AggExpr("cnt", "count", None)]),
+            parents=[src1],
+        )
+        src2 = p.add(MemorySourceOp(table="http_events"))
+        f2 = p.add(
+            FilterOp(expr=Call("equal", (Column("status"), lit(500)))), parents=[src2]
+        )
+        agg2 = p.add(
+            AggOp(groups=["service"], values=[AggExpr("errs", "count", None)]),
+            parents=[f2],
+        )
+        j = p.add(
+            JoinOp(
+                how="inner",
+                left_on=["service"],
+                right_on=["service"],
+                output=[
+                    ("right", "service", "service"),
+                    ("right", "errs", "errs"),
+                    ("left", "cnt", "cnt"),
+                ],
+            ),
+            parents=[agg1, agg2],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[j])
+        out = run(p, store)
+        got = out.to_pandas().sort_values("service").reset_index(drop=True)
+        cnt = df.groupby("service").size()
+        errs = df[df.status == 500].groupby("service").size()
+        expect = (
+            pd.DataFrame({"errs": errs, "cnt": cnt})
+            .dropna()
+            .astype(np.int64)
+            .rename_axis("service")
+            .reset_index()
+            .sort_values("service")
+            .reset_index(drop=True)
+        )
+        pd.testing.assert_frame_equal(got[["service", "errs", "cnt"]], expect, check_dtype=False)
+
+    def test_union(self, store, df):
+        p = Plan()
+        s1 = p.add(MemorySourceOp(table="http_events"))
+        f1 = p.add(FilterOp(expr=Call("equal", (Column("status"), lit(404)))), parents=[s1])
+        s2 = p.add(MemorySourceOp(table="http_events"))
+        f2 = p.add(FilterOp(expr=Call("equal", (Column("status"), lit(500)))), parents=[s2])
+        u = p.add(UnionOp(), parents=[f1, f2])
+        p.add(MemorySinkOp(name="output"), parents=[u])
+        out = run(p, store)
+        assert out.num_rows == int(((df.status == 404) | (df.status == 500)).sum())
+        assert sorted(set(out.decoded("service"))) == sorted(set(df.service))
+
+
+class TestStringOps:
+    def test_select_and_string_eq_columns(self, store, df):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        m = p.add(
+            MapOp(
+                exprs=[
+                    ("is_err", Call("greater_equal", (Column("status"), lit(400)))),
+                    ("label", Call(
+                        "select",
+                        (
+                            Call("greater_equal", (Column("status"), lit(400))),
+                            Call("to_upper", (Column("service"),)),
+                            Column("service"),
+                        ),
+                    )),
+                    ("service", Column("service")),
+                ]
+            ),
+            parents=[src],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[m])
+        out = run(p, store)
+        got = out.to_pandas()
+        expect = np.where(df.status >= 400, df.service.str.upper(), df.service)
+        assert got.label.tolist() == expect.tolist()
+
+    def test_serialization_roundtrip(self, store):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="http_events"))
+        f = p.add(FilterOp(expr=Call("equal", (Column("status"), lit(200)))), parents=[src])
+        agg = p.add(
+            AggOp(groups=["service"], values=[AggExpr("cnt", "count", None)]),
+            parents=[f],
+        )
+        p.add(MemorySinkOp(name="output"), parents=[agg])
+        p2 = Plan.from_dict(p.to_dict())
+        out1 = run(p, store).to_pandas().sort_values("service").reset_index(drop=True)
+        out2 = run(p2, store).to_pandas().sort_values("service").reset_index(drop=True)
+        pd.testing.assert_frame_equal(out1, out2)

@@ -1,0 +1,87 @@
+"""Regression tests for executor edge cases found in review."""
+import numpy as np
+import pytest
+
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.plan import (
+    AggExpr,
+    AggOp,
+    Call,
+    Column,
+    FilterOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    lit,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+
+@pytest.fixture
+def store():
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("k", DT.INT64), ("v", DT.FLOAT64))
+    t = ts.create("t", rel, batch_rows=1024)
+    n = 3000
+    t.write(
+        {
+            "time_": np.arange(n, dtype=np.int64),
+            "k": np.arange(n, dtype=np.int64),  # 3000 distinct groups
+            "v": np.ones(n),
+        }
+    )
+    return ts
+
+
+def test_large_agg_output_through_sink(store):
+    """HostBatch intermediates above MIN_BUCKET must not crash the feed."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    agg = p.add(AggOp(groups=["k"], values=[AggExpr("s", "sum", "v")]), parents=[src])
+    p.add(MemorySinkOp(name="output"), parents=[agg])
+    out = execute_plan(p, store)["output"]
+    assert out.num_rows == 3000
+    np.testing.assert_allclose(out.columns["s"], np.ones(3000))
+
+
+def test_time_bounds_without_time_projection(store):
+    """Row-level time bounds apply even when time_ is projected away."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t", columns=["k"], start_time=10, stop_time=20))
+    p.add(MemorySinkOp(name="output"), parents=[src])
+    out = execute_plan(p, store)["output"]
+    assert out.num_rows == 10
+    assert out.relation.names() == ["k"]  # hidden time_ not leaked
+    np.testing.assert_array_equal(np.sort(out.columns["k"]), np.arange(10, 20))
+
+
+def test_limit_then_filter_cross_batch(store):
+    """Limit slots are consumed by rows REACHING the limit, not surviving later
+    filters — src→Limit(5)→Filter must not emit rows from later batches."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    l = p.add(LimitOp(n=5), parents=[src])
+    f = p.add(
+        FilterOp(expr=Call("equal", (Call("modulo", (Column("k"), lit(2))), lit(0)))),
+        parents=[l],
+    )
+    p.add(MemorySinkOp(name="output"), parents=[f])
+    out = execute_plan(p, store)["output"]
+    np.testing.assert_array_equal(np.sort(out.columns["k"]), [0, 2, 4])
+
+
+def test_intdict_group_key_renamed(store):
+    """Group-by over a Map-renamed raw int column."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    m = p.add(
+        MapOp(exprs=[("k2", Column("k")), ("v", Column("v"))]), parents=[src]
+    )
+    agg = p.add(AggOp(groups=["k2"], values=[AggExpr("s", "sum", "v")]), parents=[m])
+    p.add(MemorySinkOp(name="output"), parents=[agg])
+    out = execute_plan(p, store)["output"]
+    assert out.num_rows == 3000
+    assert set(out.relation.names()) == {"k2", "s"}

@@ -1,0 +1,156 @@
+"""Kernel + UDA tests vs numpy oracles (reference: exec/agg_node_test.cc et al)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pixie_tpu.ops import LogHistogram, combine_codes, masked_segment_sum, split_codes
+from pixie_tpu.udf import registry
+from pixie_tpu.types import DataType as DT
+
+
+class TestGroupby:
+    def test_combine_split_roundtrip(self, rng):
+        c1 = rng.integers(0, 5, 100).astype(np.int32)
+        c2 = rng.integers(0, 7, 100).astype(np.int32)
+        gid, ng = combine_codes([jnp.asarray(c1), jnp.asarray(c2)], [5, 7])
+        assert ng == 35
+        back = split_codes(np.asarray(gid), [5, 7])
+        np.testing.assert_array_equal(back[0], c1)
+        np.testing.assert_array_equal(back[1], c2)
+
+    def test_masked_segment_sum(self, rng):
+        v = rng.standard_normal(64)
+        g = rng.integers(0, 4, 64)
+        m = rng.random(64) > 0.3
+        out = masked_segment_sum(jnp.asarray(v), jnp.asarray(g), 4, jnp.asarray(m))
+        expect = np.array([v[(g == i) & m].sum() for i in range(4)])
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-12)
+
+
+class TestSketch:
+    def test_quantile_accuracy(self, rng):
+        sk = LogHistogram()
+        vals = rng.exponential(50.0, 20000)
+        g = rng.integers(0, 3, 20000)
+        hist = sk.init(3)
+        hist = sk.update(hist, jnp.asarray(g), jnp.asarray(vals), jnp.ones(20000, bool), 3)
+        q = sk.quantile(np.asarray(hist), [0.5, 0.99])
+        for i in range(3):
+            exact = np.quantile(vals[g == i], [0.5, 0.99])
+            np.testing.assert_allclose(q[i], exact, rtol=0.05)
+
+    def test_merge_is_add(self, rng):
+        sk = LogHistogram()
+        a, b = rng.exponential(10.0, 5000), rng.exponential(10.0, 5000)
+        g = np.zeros(5000, dtype=np.int32)
+        m = jnp.ones(5000, bool)
+        ha = sk.update(sk.init(1), jnp.asarray(g), jnp.asarray(a), m, 1)
+        hb = sk.update(sk.init(1), jnp.asarray(g), jnp.asarray(b), m, 1)
+        merged = np.asarray(ha) + np.asarray(hb)
+        both = np.concatenate([a, b])
+        np.testing.assert_allclose(
+            sk.quantile(merged, [0.5])[0, 0], np.quantile(both, 0.5), rtol=0.05
+        )
+
+    def test_zero_and_empty_groups(self):
+        sk = LogHistogram()
+        vals = jnp.asarray(np.array([0.0, -5.0, 1.0]))
+        hist = sk.update(sk.init(2), jnp.asarray(np.array([0, 0, 0])), vals, jnp.ones(3, bool), 2)
+        q = sk.quantile(np.asarray(hist), [0.5])
+        assert q[0, 0] >= 0.0
+        assert np.isnan(q[1, 0])  # empty group
+
+
+def run_uda(name, values, groups, num_groups, mask=None, splits=2):
+    """Drive a UDA through update on `splits` chunks + merge + finalize."""
+    uda = registry.uda(name)
+    n = len(groups)
+    mask = np.ones(n, bool) if mask is None else mask
+    dtype = values.dtype if values is not None else np.int64
+    states = []
+    for lo, hi in [(i * n // splits, (i + 1) * n // splits) for i in range(splits)]:
+        s = uda.init(num_groups, dtype)
+        s = uda.update(
+            s,
+            jnp.asarray(groups[lo:hi]),
+            jnp.asarray(values[lo:hi]) if values is not None else None,
+            jnp.asarray(mask[lo:hi]),
+            num_groups,
+        )
+        states.append(s)
+    merged = states[0]
+    for s in states[1:]:
+        merged = uda.merge(merged, s)
+    import jax
+
+    return uda.finalize_host(jax.tree.map(np.asarray, merged))
+
+
+class TestUDAs:
+    @pytest.fixture
+    def data(self, rng):
+        g = rng.integers(0, 4, 1000)
+        v = rng.standard_normal(1000) * 10
+        m = rng.random(1000) > 0.2
+        return g, v, m
+
+    def test_count(self, data):
+        g, v, m = data
+        out = run_uda("count", None, g, 4, m)
+        expect = [((g == i) & m).sum() for i in range(4)]
+        np.testing.assert_array_equal(out, expect)
+
+    def test_sum_mean_min_max(self, data):
+        g, v, m = data
+        for name, fn in [
+            ("sum", np.sum),
+            ("mean", np.mean),
+            ("min", np.min),
+            ("max", np.max),
+        ]:
+            out = run_uda(name, v, g, 4, m)
+            expect = np.array([fn(v[(g == i) & m]) for i in range(4)])
+            np.testing.assert_allclose(out, expect, rtol=1e-9, err_msg=name)
+
+    def test_int_sum_stays_int(self, rng):
+        g = rng.integers(0, 2, 100)
+        v = rng.integers(0, 1000, 100)
+        out = run_uda("sum", v, g, 2)
+        assert out.dtype == np.int64
+        np.testing.assert_array_equal(out, [v[g == 0].sum(), v[g == 1].sum()])
+
+    def test_p50(self, rng):
+        g = rng.integers(0, 2, 20000)
+        v = rng.exponential(100.0, 20000)
+        out = run_uda("p50", v, g, 2)
+        for i in range(2):
+            np.testing.assert_allclose(out[i], np.quantile(v[g == i], 0.5), rtol=0.05)
+
+    def test_quantiles_json(self, rng):
+        v = rng.exponential(10.0, 5000)
+        out = run_uda("quantiles", v, np.zeros(5000, np.int64), 1)
+        assert out[0].startswith('{"p01"') and '"p99"' in out[0]
+
+
+class TestRegistry:
+    def test_overload_resolution(self):
+        f = registry.scalar("add", (DT.INT64, DT.INT64))
+        assert f.out_type == DT.INT64
+        # widening: time compared against int, int where float declared
+        f2 = registry.scalar("divide", (DT.INT64, DT.INT64))
+        assert f2.out_type == DT.FLOAT64
+        f3 = registry.scalar("bin", (DT.TIME64NS, DT.INT64))
+        assert f3.out_type == DT.TIME64NS
+
+    def test_missing(self):
+        from pixie_tpu.status import NotFound
+
+        with pytest.raises(NotFound):
+            registry.scalar("nope", ())
+        with pytest.raises(NotFound):
+            registry.scalar("add", (DT.STRING, DT.STRING))
+
+    def test_host_string(self):
+        f = registry.scalar("contains", (DT.STRING, DT.STRING))
+        assert not f.device and f.const_args == 1
+        assert f.fn("hello world", "wor") is True
