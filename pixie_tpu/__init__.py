@@ -18,5 +18,6 @@ _jax.config.update("jax_enable_x64", True)
 
 from pixie_tpu.types import DataType, SemanticType, Relation  # noqa: E402,F401
 from pixie_tpu.table import Table, TableStore, RowBatch  # noqa: E402,F401
+import pixie_tpu.metadata  # noqa: E402,F401  (registers metadata UDFs)
 
 __version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from pixie_tpu.compiler.compiler import CompiledQuery, compile_fn, compile_pxl
+from pixie_tpu.compiler.pxl import CompileCtx, DataFrame, GroupedDataFrame, Scalar
+from pixie_tpu.compiler.pxmodule import PxModule
+
+__all__ = [
+    "CompiledQuery",
+    "compile_fn",
+    "compile_pxl",
+    "CompileCtx",
+    "DataFrame",
+    "GroupedDataFrame",
+    "Scalar",
+    "PxModule",
+]

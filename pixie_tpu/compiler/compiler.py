@@ -1,0 +1,121 @@
+"""PxL compiler entry point (reference src/carnot/planner/compiler/compiler.cc:59
+Compiler::CompileToIR → Analyze → Optimize, collapsed into: trace the Python
+script against px tracer objects, then run plan-level optimizer passes).
+
+compile_pxl(source, schemas) → CompiledQuery{plan, sink names}.
+
+Scripts come in two shapes (mirroring the bundled pxl_scripts):
+  * module-level: build DataFrames and call px.display(df, name);
+  * function-based: def fn(start_time: str, ...) returning a DataFrame —
+    the caller passes `func`/`func_args`; typed parameters are coerced.
+"""
+from __future__ import annotations
+
+import dataclasses
+import sys
+import threading
+from typing import Optional
+
+from pixie_tpu.compiler import timeparse
+from pixie_tpu.compiler.optimizer import optimize
+from pixie_tpu.compiler.pxl import CompileCtx, DataFrame
+from pixie_tpu.compiler.pxmodule import PxModule
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.status import CompilerError
+from pixie_tpu.types import Relation
+
+_exec_lock = threading.Lock()
+
+
+@dataclasses.dataclass
+class CompiledQuery:
+    plan: Plan
+    sink_names: list[str]
+    now: int
+
+
+def _coerce_arg(value, annotation):
+    if isinstance(annotation, str):
+        annotation = {"int": int, "float": float, "str": str, "bool": bool}.get(annotation)
+    if annotation is int:
+        return int(value)
+    if annotation is float:
+        return float(value)
+    if annotation is str:
+        return str(value)
+    if annotation is bool:
+        return value in (True, "true", "True", "1", 1)
+    return value
+
+
+def compile_pxl(
+    source: str,
+    schemas: dict[str, Relation],
+    func: Optional[str] = None,
+    func_args: Optional[dict] = None,
+    registry=None,
+    now: Optional[int] = None,
+    default_limit: Optional[int] = None,
+) -> CompiledQuery:
+    if registry is None:
+        from pixie_tpu.udf import registry as registry_mod
+
+        registry = registry_mod
+    ctx = CompileCtx(schemas, registry, now if now is not None else timeparse.now_ns())
+    px = PxModule(ctx)
+    glb: dict = {"__name__": "pxl_script", "px": px, "__builtins__": __builtins__}
+
+    # dont_inherit: this module uses `from __future__ import annotations`, which
+    # compile() would otherwise leak into the script, stringifying the typed
+    # function parameters we coerce below.
+    code = compile(source, "<pxl>", "exec", dont_inherit=True)
+    # `import px` inside scripts resolves via sys.modules; compilation is
+    # serialized so concurrent queries don't see each other's module instance.
+    with _exec_lock:
+        saved = sys.modules.get("px")
+        sys.modules["px"] = px
+        try:
+            exec(code, glb)
+            result_df = None
+            if func is not None:
+                fn = glb.get(func)
+                if fn is None or not callable(fn):
+                    raise CompilerError(f"script has no function {func!r}")
+                anns = getattr(fn, "__annotations__", {})
+                kwargs = {}
+                for k, v in (func_args or {}).items():
+                    kwargs[k] = _coerce_arg(v, anns.get(k))
+                result_df = fn(**kwargs)
+        finally:
+            if saved is not None:
+                sys.modules["px"] = saved
+            else:
+                sys.modules.pop("px", None)
+
+    if isinstance(result_df, DataFrame) and not ctx.sinks:
+        result_df.display("output")
+    if not ctx.sinks:
+        raise CompilerError(
+            "script produced no output: call px.display(df, name) or return a DataFrame"
+        )
+
+    plan = optimize(ctx.plan, default_limit=default_limit)
+    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks], now=ctx.now)
+
+
+def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> CompiledQuery:
+    """Compile a Python callable `build(px)` directly (no source text) — the
+    programmatic API used by services and tests."""
+    if registry is None:
+        from pixie_tpu.udf import registry as registry_mod
+
+        registry = registry_mod
+    ctx = CompileCtx(schemas, registry, now if now is not None else timeparse.now_ns())
+    px = PxModule(ctx)
+    out = build(px)
+    if isinstance(out, DataFrame) and not ctx.sinks:
+        out.display("output")
+    if not ctx.sinks:
+        raise CompilerError("build fn produced no sink")
+    plan = optimize(ctx.plan)
+    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks], now=ctx.now)

@@ -1,0 +1,202 @@
+"""The `px` module surface presented to PxL scripts (reference
+src/carnot/planner/objects/pixie_module.cc).
+
+One PxModule instance exists per compilation and is injected as `px` into the
+script's namespace (and sys.modules during exec, so `import px` works).  Any
+attribute not explicitly defined falls through to the scalar-UDF registry,
+giving every builtin (px.abs, px.contains, px.upid_to_pod_name, ...) for free.
+"""
+from __future__ import annotations
+
+import types
+from typing import Optional
+
+from pixie_tpu.compiler import timeparse
+from pixie_tpu.compiler.pxl import AggMarker, CompileCtx, DataFrame, Scalar, as_scalar
+from pixie_tpu.plan.plan import Call, Literal
+from pixie_tpu.status import CompilerError
+from pixie_tpu.types import DataType as DT
+
+_AGG_NAMES = (
+    "sum",
+    "mean",
+    "count",
+    "min",
+    "max",
+    "quantiles",
+    "stddev",
+    "variance",
+    "any",
+    "count_distinct",
+) + tuple(f"p{q:02d}" for q in (1, 10, 25, 50, 75, 90, 95, 99))
+
+
+class _SemanticStr(str):
+    """Semantic-typed script parameter annotation (px.Pod, px.Namespace, ...) —
+    physically a string; the semantic type drives UI autocomplete in the
+    reference (vispb), and arg coercion here."""
+
+
+class Namespace(_SemanticStr):
+    pass
+
+
+class Pod(_SemanticStr):
+    pass
+
+
+class Service(_SemanticStr):
+    pass
+
+
+class Node(_SemanticStr):
+    pass
+
+
+class Container(_SemanticStr):
+    pass
+
+
+class PxModule(types.ModuleType):
+    Namespace = Namespace
+    Pod = Pod
+    Service = Service
+    Node = Node
+    Container = Container
+
+    def __init__(self, ctx: CompileCtx):
+        super().__init__("px", "Pixie PxL standard module (TPU build)")
+        self._ctx = ctx
+        for name in _AGG_NAMES:
+            if ctx.registry.has_uda(name):
+                setattr(self, name, AggMarker(name))
+
+    # ------------------------------------------------------------- dataframes
+    def DataFrame(self, table: str, select=None, start_time=None, end_time=None):
+        return DataFrame._from_table(
+            self._ctx, table, select=select, start_time=start_time, end_time=end_time
+        )
+
+    def display(self, df: DataFrame, name: str = "output") -> None:
+        if not isinstance(df, DataFrame):
+            raise CompilerError("px.display takes a DataFrame")
+        df.display(name)
+
+    def debug(self, df: DataFrame, name: str = "debug") -> None:
+        self.display(df, "_" + name)
+
+    # ------------------------------------------------------------------- time
+    def now(self) -> int:
+        return self._ctx.now
+
+    def nanos(self, n) -> int:
+        return int(n)
+
+    def micros(self, n) -> int:
+        return int(n) * timeparse.US
+
+    def millis(self, n) -> int:
+        return int(n) * timeparse.MS
+
+    def seconds(self, n) -> int:
+        return int(n) * timeparse.SECOND
+
+    def minutes(self, n) -> int:
+        return int(n) * timeparse.MINUTE
+
+    def hours(self, n) -> int:
+        return int(n) * timeparse.HOUR
+
+    def days(self, n) -> int:
+        return int(n) * timeparse.DAY
+
+    def parse_duration(self, s: str) -> int:
+        return timeparse.parse_duration_ns(s)
+
+    def parse_time(self, v) -> int:
+        return timeparse.resolve_time(v, self._ctx.now)
+
+    # ------------------------------------------------- type constructors/casts
+    def DurationNanos(self, v):
+        """Semantic cast → ST_DURATION_NS; physically int64 ns (pass-through)."""
+        return v
+
+    def Time(self, v):
+        return v
+
+    def uint128(self, s):
+        return s
+
+    def Bytes(self, v):
+        return v
+
+    def Percent(self, v):
+        return v
+
+    # ---------------------------------------------------------------- helpers
+    def select(self, cond, a, b) -> Scalar:
+        for v in (cond, a, b):
+            if isinstance(v, Scalar):
+                df = v.df
+                break
+        else:
+            raise CompilerError("px.select requires at least one column expression")
+        c, av, bv = as_scalar(cond, df), as_scalar(a, df), as_scalar(b, df)
+        out = df._ctx.infer_type("select", [c.dtype, av.dtype, bv.dtype])
+        return Scalar(Call("select", (c.expr, av.expr, bv.expr)), out, df)
+
+    def equals_any(self, col, values) -> Scalar:
+        if not isinstance(col, Scalar):
+            raise CompilerError("px.equals_any requires a column expression")
+        out = None
+        for v in values:
+            e = col == v
+            out = e if out is None else (out | e)
+        if out is None:
+            raise CompilerError("px.equals_any requires at least one value")
+        return out
+
+    def script_reference(self, label, script: str, args: Optional[dict] = None) -> Scalar:
+        """UI deeplink (reference builtins _script_reference). The TPU build keeps
+        the label column value; link metadata is a presentation concern carried
+        in the vis spec, not the data plane."""
+        if not isinstance(label, Scalar):
+            raise CompilerError("px.script_reference requires a column expression")
+        return label
+
+    def vis(self):  # pragma: no cover - placeholder namespace
+        raise CompilerError("px.vis is declarative; use the vis.json spec")
+
+    # Nullary context helpers (reference metadata_ops.h ASIDUDF etc.)
+    def asid(self) -> int:
+        from pixie_tpu.metadata import snapshot
+
+        return snapshot().asid
+
+    def node_name(self) -> str:
+        from pixie_tpu.metadata import snapshot
+
+        return snapshot().node_name
+
+    # ------------------------------------------------------ registry fallback
+    def __getattr__(self, name: str):
+        # Fallback: any scalar UDF in the registry becomes px.<name>(...).
+        ctx = object.__getattribute__(self, "_ctx")
+        if ctx.registry.has_scalar(name):
+            def call(*args, _name=name):
+                df = None
+                for a in args:
+                    if isinstance(a, Scalar):
+                        df = a.df
+                        break
+                if df is None:
+                    raise CompilerError(
+                        f"px.{_name} requires at least one column expression argument"
+                    )
+                svals = [as_scalar(a, df) for a in args]
+                out = ctx.infer_type(_name, [s.dtype for s in svals])
+                return Scalar(Call(_name, tuple(s.expr for s in svals)), out, df)
+
+            call.__name__ = name
+            return call
+        raise AttributeError(f"px has no attribute {name!r}")

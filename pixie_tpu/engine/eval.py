@@ -54,6 +54,14 @@ def apply_lut(lut: jax.Array, codes: jax.Array, fill):
     return jnp.where(codes >= 0, out, jnp.asarray(fill, dtype=out.dtype))
 
 
+def apply_lut_np(lut: np.ndarray, codes: np.ndarray, fill=-1) -> np.ndarray:
+    """Host (numpy) twin of apply_lut for join/union code translation."""
+    if len(lut) == 0:
+        return np.full_like(codes, fill)
+    out = lut[np.clip(codes, 0, len(lut) - 1)]
+    return np.where(codes >= 0, out, fill)
+
+
 class ExprCompiler:
     """Compiles Exprs against a column environment (dtypes + dictionaries).
 
@@ -67,7 +75,10 @@ class ExprCompiler:
         self.registry = registry
         self.luts: dict[str, np.ndarray] = {}
         self._n = 0
-        self._memo: dict[int, SVal] = {}
+        # Memo holds (expr, SVal): the strong ref to expr is REQUIRED — keying
+        # by id() of a dead object would let a newly allocated Expr reuse the
+        # address and silently hit the wrong cache entry.
+        self._memo: dict[int, tuple[Expr, SVal]] = {}
 
     # ---------------------------------------------------------------- helpers
     def _add_lut(self, arr: np.ndarray) -> str:
@@ -96,7 +107,7 @@ class ExprCompiler:
         # for nested host calls (and shared subexpressions compile once).
         got = self._memo.get(id(expr))
         if got is not None:
-            return got
+            return got[1]
         if isinstance(expr, Column):
             out = self._compile_column(expr)
         elif isinstance(expr, Literal):
@@ -105,7 +116,7 @@ class ExprCompiler:
             out = self._compile_call(expr)
         else:
             raise CompilerError(f"unknown expression node {type(expr).__name__}")
-        self._memo[id(expr)] = out
+        self._memo[id(expr)] = (expr, out)
         return out
 
     def _compile_column(self, expr: Column) -> SVal:
@@ -168,44 +179,99 @@ class ExprCompiler:
         return SVal(udf.out_type, build)
 
     def _host_call(self, call: Call, udf, arg_types) -> SVal:
-        """Host string UDF → LUT over the first arg's dictionary.
+        """Host UDF → device LUT.
 
-        Layout convention: arg0 is the string column; the last `const_args` args
-        must be literals passed straight to the python fn.
+        Two evaluation strategies (both O(domain), not O(rows)):
+          * dictionary UDFs: exactly one argument is a dict-encoded column (any
+            position); remaining args must be literals.  fn runs over the
+            dictionary values → LUT applied by code.
+          * bounded-int-domain UDFs (udf.int_domain): the column argument is a
+            plain integer; fn runs over the [lo, hi] domain → LUT applied by
+            clamped value (enum decoders: http_resp_message, protocol_name...).
         """
-        s = self.compile(call.args[0])
-        if s.dictionary is None:
-            raise CompilerError(f"{udf.name}: first argument must be a string column")
-        consts = []
-        for a in call.args[1:]:
+        if udf.int_domain is not None:
+            return self._int_domain_call(call, udf)
+        col_idx = None
+        for i, a in enumerate(call.args):
             if not isinstance(a, Literal):
-                raise CompilerError(
-                    f"{udf.name}: argument {a!r} must be a literal (host UDFs evaluate "
-                    "over dictionaries, not rows)"
-                )
-            consts.append(a.value)
+                if col_idx is not None:
+                    raise CompilerError(
+                        f"{udf.name}: host UDFs take exactly one column argument "
+                        "(others must be literals)"
+                    )
+                col_idx = i
+        if col_idx is None:
+            raise CompilerError(f"{udf.name}: needs one column argument")
+        s = self.compile(call.args[col_idx])
+        if s.dictionary is None:
+            raise CompilerError(
+                f"{udf.name}: column argument must be dictionary-encoded (STRING/UINT128)"
+            )
+        consts = [a.value for i, a in enumerate(call.args) if i != col_idx]
+
+        def call_fn(v, fn=udf.fn, idx=col_idx, consts=consts):
+            args = list(consts)
+            args.insert(idx, v)
+            return fn(*args)
+
         size = s.dictionary.size
+        b = s.build
         if udf.out_type == DT.STRING:
             out_dict = Dictionary()
-            lut = s.dictionary.lut(
-                lambda v: out_dict.code(udf.fn(v, *consts)), np.int32, size=size
-            )
+            lut = s.dictionary.lut(lambda v: out_dict.code(call_fn(v)), np.int32, size=size)
             name = self._add_lut(lut)
-            b = s.build
             return SVal(
                 DT.STRING,
                 lambda env, name=name, b=b: apply_lut(env["luts"][name], b(env), -1),
                 out_dict,
             )
         np_out = STORAGE_DTYPE[udf.out_type]
-        lut = s.dictionary.lut(lambda v: udf.fn(v, *consts), np_out, size=size)
+        lut = s.dictionary.lut(call_fn, np_out, size=size)
         name = self._add_lut(lut)
-        b = s.build
         fill = False if udf.out_type == DT.BOOLEAN else 0
         return SVal(
             udf.out_type,
             lambda env, name=name, b=b, fill=fill: apply_lut(env["luts"][name], b(env), fill),
         )
+
+    def _int_domain_call(self, call: Call, udf) -> SVal:
+        lo, hi = udf.int_domain
+        v = self.compile(call.args[0])
+        if v.dtype not in (DT.INT64, DT.TIME64NS):
+            raise CompilerError(f"{udf.name}: argument must be an integer column")
+        consts = []
+        for a in call.args[1:]:
+            if not isinstance(a, Literal):
+                raise CompilerError(f"{udf.name}: trailing arguments must be literals")
+            consts.append(a.value)
+        vals = [udf.fn(i, *consts) for i in range(lo, hi + 1)]
+        b = v.build
+        if udf.out_type == DT.STRING:
+            out_dict = Dictionary()
+            lut = np.asarray([out_dict.code(x) for x in vals], dtype=np.int32)
+            oob = out_dict.code(udf.fn(lo - 1, *consts))  # out-of-domain value
+            name = self._add_lut(lut)
+
+            def build(env, name=name, b=b, lo=lo, hi=hi, oob=oob):
+                x = b(env)
+                in_dom = (x >= lo) & (x <= hi)
+                idx = jnp.clip(x - lo, 0, hi - lo).astype(jnp.int32)
+                return jnp.where(in_dom, jnp.take(env["luts"][name], idx), oob)
+
+            return SVal(DT.STRING, build, out_dict)
+        np_out = STORAGE_DTYPE[udf.out_type]
+        lut = np.asarray(vals, dtype=np_out)
+        oob_v = udf.fn(lo - 1, *consts)
+        name = self._add_lut(lut)
+
+        def build_n(env, name=name, b=b, lo=lo, hi=hi, oob_v=oob_v):
+            x = b(env)
+            in_dom = (x >= lo) & (x <= hi)
+            idx = jnp.clip(x - lo, 0, hi - lo).astype(jnp.int32)
+            return jnp.where(in_dom, jnp.take(env["luts"][name], idx),
+                             jnp.asarray(oob_v, dtype=lut.dtype))
+
+        return SVal(udf.out_type, build_n)
 
     def _string_equality(self, call: Call, negate: bool) -> SVal:
         lhs_e, rhs_e = call.args

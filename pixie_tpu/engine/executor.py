@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from pixie_tpu.engine.eval import ExprCompiler, SVal, apply_lut
+from pixie_tpu.engine.eval import ExprCompiler, SVal, apply_lut, apply_lut_np
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.plan.plan import (
     AggOp,
@@ -266,7 +266,14 @@ class ChainKernel:
             mask = self._base_mask(env, n, n_valid, t_lo, t_hi)
             mask, consumed = self._apply_steps(env, mask, limit_remaining)
             if keys:
-                gid, _ = combine_codes([kb(env) for kb in key_builders], cards)
+                code_arrays = [kb(env) for kb in key_builders]
+                # Null keys (code -1, e.g. unmatched left-join fills) drop out
+                # of the aggregate (pandas dropna semantics); without this,
+                # combine_codes would clamp them into group 0.
+                for k, c in zip(keys, code_arrays):
+                    if k.kind in ("dict", "intdict"):
+                        mask = mask & (c >= 0)
+                gid, _ = combine_codes(code_arrays, cards)
             else:
                 gid = jnp.zeros(n, dtype=jnp.int32)
             new_state = {}
@@ -509,10 +516,12 @@ class PlanExecutor:
         seen_name = "__seen"
         from pixie_tpu.udf.udf import CountUDA
 
+        in_types: dict[str, DT | None] = {}
         for ae in [*op.values]:
             uda = self.registry.uda(ae.fn)
             vb = None
             in_dtype = None
+            in_types[ae.out_name] = None
             if ae.arg is not None:
                 sv = kern.ctx.sym.get(ae.arg)
                 if sv is None:
@@ -521,6 +530,7 @@ class PlanExecutor:
                     raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
                 vb = sv.build
                 in_dtype = STORAGE_DTYPE[sv.dtype]
+                in_types[ae.out_name] = sv.dtype
             elif not uda.nullary:
                 raise CompilerError(f"aggregate {ae.fn} requires an input column")
             udas.append((ae.out_name, uda, vb))
@@ -547,9 +557,9 @@ class PlanExecutor:
                     break
 
         state_np = jax.tree.map(np.asarray, state)
-        return self._finalize_agg(op, keys, udas, state_np, seen_name)
+        return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
 
-    def _finalize_agg(self, op, keys, udas, state_np, seen_name) -> HostBatch:
+    def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None) -> HostBatch:
         from pixie_tpu.ops.groupby import split_codes
 
         seen_counts = np.asarray(state_np[seen_name])
@@ -579,7 +589,15 @@ class PlanExecutor:
                 continue
             full = uda.finalize_host(jax.tree.map(lambda x: x, state_np[out_name]))
             vals = np.asarray(full)[gids]
-            out_dt = uda.out_type(None) if uda.nullary else uda.out_type(_dtype_of(full))
+            # Use the DECLARED input DataType so e.g. min(time_) stays TIME64NS
+            # (matching the compile-time schema); fall back to array inference
+            # for callers that bypass _run_agg.
+            if uda.nullary:
+                out_dt = uda.out_type(None)
+            elif in_types is not None and out_name in in_types:
+                out_dt = uda.out_type(in_types[out_name])
+            else:
+                out_dt = uda.out_type(_dtype_of(full))
             if out_dt == DT.STRING:
                 d = Dictionary()
                 cols[out_name] = d.encode(vals)
@@ -600,16 +618,23 @@ class PlanExecutor:
             raise CompilerError("join requires equal, non-empty key lists")
 
         # Normalize keys to comparable numpy arrays (codes translated to the
-        # left dictionary space; raw values otherwise).
+        # left dictionary space; raw values otherwise).  Null dict codes (-1,
+        # e.g. unmatched fills from an earlier left join or untranslatable
+        # values) must never equal each other, so they are masked out of both
+        # the build and probe sides.
         lkeys, rkeys = [], []
+        lnull = np.zeros(left.num_rows, dtype=bool)
+        rnull = np.zeros(right.num_rows, dtype=bool)
         for lk, rk in zip(op.left_on, op.right_on):
             lv, rv = left.cols[lk], right.cols[rk]
             ld, rd = left.dicts.get(lk), right.dicts.get(rk)
             if (ld is None) != (rd is None):
                 raise CompilerError(f"join key {lk}/{rk}: dictionary/plain mismatch")
-            if ld is not None and rd is not None and ld is not rd:
-                lut = rd.translate_to(ld, insert=False)
-                rv = np.where(rv >= 0, lut[np.clip(rv, 0, max(len(lut) - 1, 0))], -1) if len(lut) else np.full_like(rv, -1)
+            if ld is not None:
+                lnull |= lv < 0
+                if rd is not ld:
+                    rv = apply_lut_np(rd.translate_to(ld, insert=False), rv)
+                rnull |= rv < 0
             lkeys.append(lv)
             rkeys.append(rv)
 
@@ -620,10 +645,12 @@ class PlanExecutor:
         ridx = np.searchsorted(uniq, rcomp)
         ridx_c = np.clip(ridx, 0, max(len(uniq) - 1, 0))
         found = (len(uniq) > 0) & (uniq[ridx_c] == rcomp) if len(uniq) else np.zeros(len(rcomp), bool)
-        # Build: last row per key wins (duplicate build keys collapse; the
+        found &= ~rnull
+        # Build: last VALID row per key wins (duplicate build keys collapse; the
         # many-to-many expansion is the sort-merge upgrade).
         build_row = np.full(len(uniq), -1, dtype=np.int64)
-        build_row[linv] = np.arange(len(lcomp))
+        lvalid = np.nonzero(~lnull)[0]
+        build_row[linv[lvalid]] = lvalid
         bidx = np.where(found, build_row[ridx_c], -1)
 
         keep = bidx >= 0
@@ -668,12 +695,7 @@ class PlanExecutor:
                 dicts[name] = target
                 for b in batches:
                     lut = b.dicts[name].translate_to(target, insert=True)
-                    c = b.cols[name]
-                    parts.append(
-                        np.where(c >= 0, lut[np.clip(c, 0, max(len(lut) - 1, 0))], -1)
-                        if len(lut)
-                        else c
-                    )
+                    parts.append(apply_lut_np(lut, b.cols[name]))
             else:
                 parts = [b.cols[name] for b in batches]
             cols[name] = np.concatenate(parts) if parts else np.empty(0)
@@ -754,20 +776,12 @@ def _window_key(expr) -> Optional[int]:
 
 
 def _source_time_range(src, head) -> tuple[int, int]:
-    t_min, t_max = None, None
     if isinstance(src, HostBatch):
         raise Unimplemented("window group keys require a table source")
-    for rb, _rid, _gen in src:
-        tc = src.table.time_col
-        if tc is None:
-            raise Unimplemented("window group keys require a time_ column")
-        t = rb.columns[tc][: rb.num_valid]
-        if len(t):
-            mn, mx = int(t.min()), int(t.max())
-            t_min = mn if t_min is None else min(t_min, mn)
-            t_max = mx if t_max is None else max(t_max, mx)
-    if t_min is None:
-        t_min, t_max = 0, 0
+    if src.table.time_col is None:
+        raise Unimplemented("window group keys require a time_ column")
+    rng = src.time_range()  # O(batches): sealed bounds cached at seal time
+    t_min, t_max = rng if rng is not None else (0, 0)
     if isinstance(head, MemorySourceOp):
         if head.start_time is not None:
             t_min = max(t_min, int(head.start_time))

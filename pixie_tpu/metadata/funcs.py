@@ -1,0 +1,153 @@
+"""Metadata scalar UDFs (reference src/carnot/funcs/metadata/metadata_ops.h).
+
+All are *host* UDFs: they evaluate over dictionary values (unique UPIDs /
+strings), never over rows — see pixie_tpu/engine/eval.py host-call path.  Each
+resolves against the current K8sSnapshot at query-compile time, which matches
+the reference's semantics of resolving against the agent's metadata state at
+execution time (state is epoch-swapped; a query sees one epoch).
+"""
+from __future__ import annotations
+
+from pixie_tpu.metadata import state as mdstate
+from pixie_tpu.types import DataType as DT
+from pixie_tpu.types import UInt128
+from pixie_tpu.udf.udf import Registry, ScalarUDF
+
+_S = DT.STRING
+_U = DT.UINT128
+_I = DT.INT64
+
+
+def _pod(upid: UInt128):
+    return mdstate.snapshot().pod_of_upid(upid)
+
+
+def _svc(upid: UInt128):
+    return mdstate.snapshot().service_of_upid(upid)
+
+
+def _host(name, args, out, fn):
+    return ScalarUDF(name=name, arg_types=args, out_type=out, fn=fn, device=False)
+
+
+def register_metadata_funcs(r: Registry) -> None:
+    # ---- upid_to_* (reference metadata_ops.h UPIDTo*UDF)
+    r.register(_host("upid_to_pod_name", (_U,), _S,
+                     lambda u: (_pod(u).qualified_name if _pod(u) else "")))
+    r.register(_host("upid_to_pod_id", (_U,), _S,
+                     lambda u: (_pod(u).uid if _pod(u) else "")))
+    r.register(_host("upid_to_namespace", (_U,), _S,
+                     lambda u: (_pod(u).namespace if _pod(u) else "")))
+    r.register(_host("upid_to_node_name", (_U,), _S,
+                     lambda u: (_pod(u).node if _pod(u) else "")))
+    r.register(_host("upid_to_service_name", (_U,), _S,
+                     lambda u: (_svc(u).qualified_name if _svc(u) else "")))
+    r.register(_host("upid_to_service_id", (_U,), _S,
+                     lambda u: (_svc(u).uid if _svc(u) else "")))
+    r.register(_host("upid_to_container_id", (_U,), _S,
+                     lambda u: mdstate.snapshot().upid_to_container_id.get(u, "")))
+    r.register(_host("upid_to_container_name", (_U,), _S, _upid_to_container_name))
+    r.register(_host("upid_to_deployment_name", (_U,), _S,
+                     lambda u: (_pod(u).owner_deployment if _pod(u) else "")))
+    r.register(_host("upid_to_cmdline", (_U,), _S,
+                     lambda u: mdstate.snapshot().upid_to_cmdline.get(u, "")))
+    r.register(_host("upid_to_pid", (_U,), _I, lambda u: u.pid))
+    r.register(_host("upid_to_asid", (_U,), _I, lambda u: u.asid))
+    r.register(_host("upid_to_string", (_U,), _S, str))
+
+    # ---- pod/service/ip lookups
+    r.register(_host("pod_id_to_pod_name", (_S,), _S,
+                     lambda uid: _qname(mdstate.snapshot().pods_by_uid.get(uid))))
+    r.register(_host("pod_id_to_namespace", (_S,), _S,
+                     lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "namespace")))
+    r.register(_host("pod_id_to_node_name", (_S,), _S,
+                     lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "node")))
+    r.register(_host("pod_id_to_service_name", (_S,), _S, _pod_id_to_service_name))
+    r.register(_host("pod_name_to_pod_id", (_S,), _S, _pod_name_to_pod_id))
+    r.register(_host("pod_name_to_namespace", (_S,), _S,
+                     lambda qn: qn.split("/", 1)[0] if "/" in qn else ""))
+    r.register(_host("pod_name_to_service_name", (_S,), _S,
+                     lambda qn: _pod_id_to_service_name(_pod_name_to_pod_id(qn))))
+    r.register(_host("pod_name_to_pod_status", (_S,), _S,
+                     lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)), "phase")))
+    r.register(_host("pod_name_to_pod_ip", (_S,), _S,
+                     lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)), "ip")))
+    r.register(_host("service_id_to_service_name", (_S,), _S,
+                     lambda uid: _qname(mdstate.snapshot().services_by_uid.get(uid))))
+    r.register(_host("service_name_to_service_id", (_S,), _S, _service_name_to_service_id))
+    r.register(_host("ip_to_pod_id", (_S,), _S,
+                     lambda ip: _attr(mdstate.snapshot().pod_of_ip(ip), "uid")))
+    r.register(_host("ip_to_svc_name", (_S,), _S,
+                     lambda ip: _qname(mdstate.snapshot().service_of_ip(ip))))
+    r.register(_host("nslookup", (_S,), _S, lambda ip: mdstate.snapshot().nslookup(ip)))
+    r.register(_host("pod_name_to_start_time", (_S,), DT.TIME64NS,
+                     lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)),
+                                      "create_time_ns", 0)))
+    r.register(_host("has_service_name", (_S,), DT.BOOLEAN, lambda qn: qn != ""))
+    r.register(_host("has_service_id", (_S,), DT.BOOLEAN, lambda uid: uid != ""))
+
+    # Current-context nullary helpers are provided by the compiler (px module)
+    # because they need no column input: px.asid(), px.node_name().
+
+
+def _qname(obj) -> str:
+    return obj.qualified_name if obj else ""
+
+
+def _attr(obj, name, default=""):
+    return getattr(obj, name) if obj else default
+
+
+def _upid_to_container_name(u: UInt128) -> str:
+    s = mdstate.snapshot()
+    cid = s.upid_to_container_id.get(u, "")
+    c = s.containers_by_id.get(cid)
+    return c.name if c else ""
+
+
+def _pod_id_to_service_name(uid: str) -> str:
+    s = mdstate.snapshot()
+    for suid in s.pod_uid_to_service_uids.get(uid, ()):
+        svc = s.services_by_uid.get(suid)
+        if svc:
+            return svc.qualified_name
+    return ""
+
+
+def _pod_name_to_pod_id(qualified: str) -> str:
+    return mdstate.snapshot().pod_name_to_uid.get(qualified, "")
+
+
+def _service_name_to_service_id(qualified: str) -> str:
+    return mdstate.snapshot().service_name_to_uid.get(qualified, "")
+
+
+# Self-register into the process-global registry on import (pixie_tpu/__init__
+# imports this package, so any use of the framework has metadata funcs).
+from pixie_tpu.udf import registry as _global_registry  # noqa: E402
+
+register_metadata_funcs(_global_registry)
+
+
+#: ctx key → (udf name, required input column). Reference: the analyzer's
+#: metadata-conversion rule rewrites df.ctx['pod'] into upid_to_pod_name(upid)
+#: (planner/compiler/analyzer, metadata resolution).
+CTX_KEYS = {
+    "pod": ("upid_to_pod_name", "upid"),
+    "pod_name": ("upid_to_pod_name", "upid"),
+    "pod_id": ("upid_to_pod_id", "upid"),
+    "service": ("upid_to_service_name", "upid"),
+    "service_name": ("upid_to_service_name", "upid"),
+    "service_id": ("upid_to_service_id", "upid"),
+    "namespace": ("upid_to_namespace", "upid"),
+    "node": ("upid_to_node_name", "upid"),
+    "node_name": ("upid_to_node_name", "upid"),
+    "container": ("upid_to_container_name", "upid"),
+    "container_name": ("upid_to_container_name", "upid"),
+    "container_id": ("upid_to_container_id", "upid"),
+    "deployment": ("upid_to_deployment_name", "upid"),
+    "cmdline": ("upid_to_cmdline", "upid"),
+    "cmd": ("upid_to_cmdline", "upid"),
+    "pid": ("upid_to_pid", "upid"),
+    "asid": ("upid_to_asid", "upid"),
+}

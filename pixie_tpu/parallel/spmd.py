@@ -53,6 +53,24 @@ def collective_merge(state, reduce_tree, axis_name: str):
     )
 
 
+def collective_merge_carry(carry, new_state, reduce_tree, axis_name: str):
+    """Merge states across a mesh axis when `new_state` was seeded from a
+    REPLICATED carry (multi-batch streaming).
+
+    psum of the full state would multiply the carried prefix by the axis size,
+    so additive leaves sum only the per-device delta; min/max collectives are
+    idempotent over the replicated carry and merge the full state directly.
+    """
+
+    def leaf(op, c, x):
+        if op == "add":
+            return c + lax.psum(x - c, axis_name)
+        return _COLLECTIVE[op](x, axis_name)
+
+    return jax.tree.map(leaf, reduce_tree, carry, new_state,
+                        is_leaf=lambda x: isinstance(x, str))
+
+
 def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
     """Lift a single-device agg step into an SPMD step over `mesh`.
 
@@ -70,7 +88,9 @@ def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
         cols = jax.tree.map(lambda x: x[0], cols)
         nv = n_valid[0]
         new_state, cnt, _consumed = raw_step(cols, nv, t_lo, t_hi, limit, luts, state)
-        merged = collective_merge(new_state, reduce_tree, axis)
+        # `state` may be a replicated carry from a previous batch, so additive
+        # leaves must psum only this batch's delta (see collective_merge_carry).
+        merged = collective_merge_carry(state, new_state, reduce_tree, axis)
         total = lax.psum(cnt, axis)
         return merged, total
 

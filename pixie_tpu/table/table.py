@@ -205,12 +205,18 @@ class Cursor:
         self.start_time = start_time
         self.stop_time = stop_time
         self._items: list[tuple[RowBatch, int, int | None]] = []
+        #: (min_time, max_time) per item, from seal-time metadata; None = unknown
+        #: (hot remainder) — aligned with _items for O(batches) time_range().
+        self._bounds: list[tuple[int, int] | None] = []
         for sb in sealed:
             if start_time is not None and sb.max_time is not None and sb.max_time < start_time:
                 continue
             if stop_time is not None and sb.min_time is not None and sb.min_time >= stop_time:
                 continue
             self._items.append((sb.batch, sb.row_id_start, sb.gen))
+            self._bounds.append(
+                (sb.min_time, sb.max_time) if sb.min_time is not None else None
+            )
         if hot is not None:
             tc = table.time_col
             keep = True
@@ -222,6 +228,7 @@ class Cursor:
                     keep = False
             if keep:
                 self._items.append((hot, hot_row_id, None))
+                self._bounds.append(None)
 
     def __iter__(self) -> Iterator[tuple[RowBatch, int, int | None]]:
         return iter(self._items)
@@ -231,6 +238,27 @@ class Cursor:
 
     def num_rows(self) -> int:
         return sum(b.num_valid for b, _, _ in self._items)
+
+    def time_range(self) -> tuple[int, int] | None:
+        """(min, max) time over the snapshot, using seal-time bounds — only the
+        hot remainder is scanned, so this is O(sealed batches + hot rows)."""
+        tc = self.table.time_col
+        if tc is None:
+            return None
+        t_min = t_max = None
+        for (b, _rid, _gen), bounds in zip(self._items, self._bounds):
+            if bounds is None:
+                t = b.columns[tc][: b.num_valid]
+                if not len(t):
+                    continue
+                mn, mx = int(t.min()), int(t.max())
+            else:
+                mn, mx = bounds
+            t_min = mn if t_min is None else min(t_min, mn)
+            t_max = mx if t_max is None else max(t_max, mx)
+        if t_min is None:
+            return None
+        return t_min, t_max
 
 
 class TableStore:

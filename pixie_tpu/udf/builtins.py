@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from pixie_tpu.types import DataType as DT
 from pixie_tpu.udf.udf import (
+    AnyUDA,
     CountUDA,
     MaxUDA,
     MeanUDA,
@@ -23,7 +24,9 @@ from pixie_tpu.udf.udf import (
     QuantilesUDA,
     Registry,
     ScalarUDF,
+    StddevUDA,
     SumUDA,
+    VarianceUDA,
 )
 
 _B, _I, _F, _S, _T = DT.BOOLEAN, DT.INT64, DT.FLOAT64, DT.STRING, DT.TIME64NS
@@ -33,9 +36,14 @@ def _dev(name, args, out, fn):
     return ScalarUDF(name=name, arg_types=tuple(args), out_type=out, fn=fn, device=True)
 
 
-def _host(name, args, out, fn, const_args=0):
+def _host(name, args, out, fn):
+    return ScalarUDF(name=name, arg_types=tuple(args), out_type=out, fn=fn, device=False)
+
+
+def _enum(name, out, fn, lo, hi):
+    """Bounded-int-domain decoder → device LUT (see eval._int_domain_call)."""
     return ScalarUDF(
-        name=name, arg_types=tuple(args), out_type=out, fn=fn, device=False, const_args=const_args
+        name=name, arg_types=(_I,), out_type=out, fn=fn, device=False, int_domain=(lo, hi)
     )
 
 
@@ -86,40 +94,82 @@ def register_all(r: Registry) -> None:
     for t in (_I, _F, _B, _T):
         r.register(_dev("select", (_B, t, t), t, lambda c, a, b: jnp.where(c, a, b)))
 
+    # More math (reference math_ops.cc)
+    r.register(_dev("ln", (_F,), _F, jnp.log))
+    r.register(_dev("negate", (_F,), _F, lambda a: -a))
+    r.register(_dev("negate", (_I,), _I, lambda a: -a))
+    r.register(_dev("invert", (_F,), _F, lambda a: 1.0 / a))
+    # time casts (reference string_ops int64_to_time / time_to_int64)
+    r.register(_dev("int64_to_time", (_I,), _T, lambda a: a))
+    r.register(_dev("time_to_int64", (_T,), _I, lambda a: a))
+
     # ------------------------------------------------------------ string (host)
     r.register(_host("length", (_S,), _I, lambda s: len(s)))
-    r.register(_host("contains", (_S, _S), _B, lambda s, sub: sub in s, const_args=1))
-    r.register(_host("find", (_S, _S), _I, lambda s, sub: s.find(sub), const_args=1))
+    r.register(_host("contains", (_S, _S), _B, lambda s, sub: sub in s))
+    r.register(_host("find", (_S, _S), _I, lambda s, sub: s.find(sub)))
     r.register(_host("to_upper", (_S,), _S, lambda s: s.upper()))
     r.register(_host("to_lower", (_S,), _S, lambda s: s.lower()))
+    r.register(_host("toupper", (_S,), _S, lambda s: s.upper()))
+    r.register(_host("tolower", (_S,), _S, lambda s: s.lower()))
     r.register(_host("trim", (_S,), _S, lambda s: s.strip()))
+    r.register(_host("atoi", (_S,), _I, _atoi))
+    r.register(_host("bytes_to_hex", (_S,), _S, lambda s: s.encode().hex()))
+    r.register(_host("hex_to_ascii", (_S,), _S, _hex_to_ascii))
+    # strip_prefix(prefix, s) — reference string_ops.cc argument order.
+    r.register(_host("strip_prefix", (_S, _S), _S,
+                     lambda prefix, s: s[len(prefix):] if s.startswith(prefix) else s))
     r.register(
         _host(
             "substring",
             (_S, _I, _I),
             _S,
             lambda s, start, length: s[start : start + length],
-            const_args=2,
         )
     )
+    # regex_match(pattern, s) — reference regex_ops.cc argument order.
     r.register(
         _host(
             "regex_match",
             (_S, _S),
             _B,
-            lambda s, pattern: re.fullmatch(pattern, s) is not None,
-            const_args=1,
+            lambda pattern, s: re.fullmatch(pattern, s) is not None,
         )
     )
+    # replace(pattern, s, sub): regex replace (reference regex_ops.cc).
+    r.register(_host("replace", (_S, _S, _S), _S,
+                     lambda pattern, s, sub: re.sub(pattern, sub, s)))
     r.register(
         _host(
             "regex_replace",
             (_S, _S, _S),
             _S,
             lambda s, pattern, repl: re.sub(pattern, repl, s),
-            const_args=2,
         )
     )
+
+    # ---------------------------------------------------------------- JSON ops
+    # (reference json_ops.cc; evaluated over unique strings only)
+    r.register(_host("pluck", (_S, _S), _S, _pluck_str))
+    r.register(_host("pluck_int64", (_S, _S), _I, _pluck_int))
+    r.register(_host("pluck_float64", (_S, _S), _F, _pluck_float))
+    r.register(_host("pluck_array", (_S, _I), _S, _pluck_array))
+
+    # --------------------------------------------------------- SQL normalization
+    # (reference sql_ops.cc: replace literals with placeholders)
+    r.register(_host("normalize_mysql", (_S,), _S, _normalize_sql))
+    r.register(_host("normalize_pgsql", (_S,), _S, _normalize_sql))
+    r.register(_host("normalize_sql", (_S,), _S, _normalize_sql))
+
+    # ------------------------------------------------------------ PII redaction
+    # (reference pii_ops.cc best-effort regex redaction)
+    r.register(_host("redact_pii_best_effort", (_S,), _S, _redact_pii))
+
+    # --------------------------------------------------- protocol enum decoders
+    # Bounded-int-domain → device LUT (reference funcs/protocols/*.cc).
+    r.register(_enum("http_resp_message", _S, _http_resp_message, 100, 599))
+    r.register(_enum("kafka_api_key_name", _S, _kafka_api_key_name, 0, 67))
+    r.register(_enum("mysql_command_name", _S, _mysql_command_name, 0, 32))
+    r.register(_enum("protocol_name", _S, _protocol_name, 0, 12))
 
     # -------------------------------------------------------------------- UDAs
     r.register_uda("count", CountUDA)
@@ -127,6 +177,161 @@ def register_all(r: Registry) -> None:
     r.register_uda("mean", MeanUDA)
     r.register_uda("min", MinUDA)
     r.register_uda("max", MaxUDA)
+    r.register_uda("stddev", StddevUDA)
+    r.register_uda("variance", VarianceUDA)
+    r.register_uda("any", AnyUDA)
     r.register_uda("quantiles", QuantilesUDA)
     for q in (0.01, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99):
         r.register_uda(f"p{int(round(q*100)):02d}", (lambda q=q: QuantileUDA(q)))
+
+
+# ------------------------------------------------------------- host fn helpers
+
+
+def _atoi(s: str) -> int:
+    try:
+        return int(s.strip())
+    except (ValueError, TypeError):
+        return 0
+
+
+def _hex_to_ascii(s: str) -> str:
+    try:
+        return bytes.fromhex(s).decode("ascii", errors="replace")
+    except ValueError:
+        return ""
+
+
+def _json_get(s: str, key: str):
+    import json
+
+    try:
+        obj = json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    if isinstance(obj, dict):
+        return obj.get(key)
+    return None
+
+
+def _pluck_str(s: str, key: str) -> str:
+    import json
+
+    v = _json_get(s, key)
+    if v is None:
+        return ""
+    if isinstance(v, str):
+        return v
+    return json.dumps(v, separators=(",", ":"))
+
+
+def _pluck_int(s: str, key: str) -> int:
+    v = _json_get(s, key)
+    try:
+        return int(v)
+    except (ValueError, TypeError):
+        return 0
+
+
+def _pluck_float(s: str, key: str) -> float:
+    v = _json_get(s, key)
+    try:
+        return float(v)
+    except (ValueError, TypeError):
+        return float("nan")
+
+
+def _pluck_array(s: str, idx: int) -> str:
+    import json
+
+    try:
+        obj = json.loads(s)
+    except (ValueError, TypeError):
+        return ""
+    if isinstance(obj, list) and -len(obj) <= idx < len(obj):
+        v = obj[idx]
+        return v if isinstance(v, str) else json.dumps(v, separators=(",", ":"))
+    return ""
+
+
+_SQL_STRING_RE = re.compile(r"'(?:[^'\\]|\\.)*'|\"(?:[^\"\\]|\\.)*\"")
+_SQL_NUMBER_RE = re.compile(r"\b\d+(?:\.\d+)?\b")
+
+
+def _normalize_sql(q: str) -> str:
+    q = _SQL_STRING_RE.sub("?", q)
+    q = _SQL_NUMBER_RE.sub("?", q)
+    return re.sub(r"\s+", " ", q).strip()
+
+
+_PII_RES = [
+    re.compile(r"[\w.+-]+@[\w-]+\.[\w.-]+"),                       # email
+    re.compile(r"\b(?:\d{1,3}\.){3}\d{1,3}\b"),                    # IPv4
+    re.compile(r"\b(?:[0-9a-fA-F]{1,4}:){4,7}[0-9a-fA-F]{0,4}\b"),  # IPv6-ish
+    re.compile(r"\b(?:\d[ -]?){13,19}\b"),                         # card numbers
+]
+
+
+def _redact_pii(s: str) -> str:
+    for rx in _PII_RES:
+        s = rx.sub("<REDACTED>", s)
+    return s
+
+
+def _http_resp_message(code: int) -> str:
+    import http.client
+
+    return http.client.responses.get(code, "Unknown")
+
+
+_KAFKA_APIS = {
+    0: "Produce", 1: "Fetch", 2: "ListOffsets", 3: "Metadata", 4: "LeaderAndIsr",
+    5: "StopReplica", 6: "UpdateMetadata", 7: "ControlledShutdown", 8: "OffsetCommit",
+    9: "OffsetFetch", 10: "FindCoordinator", 11: "JoinGroup", 12: "Heartbeat",
+    13: "LeaveGroup", 14: "SyncGroup", 15: "DescribeGroups", 16: "ListGroups",
+    17: "SaslHandshake", 18: "ApiVersions", 19: "CreateTopics", 20: "DeleteTopics",
+    21: "DeleteRecords", 22: "InitProducerId", 23: "OffsetForLeaderEpoch",
+    24: "AddPartitionsToTxn", 25: "AddOffsetsToTxn", 26: "EndTxn",
+    27: "WriteTxnMarkers", 28: "TxnOffsetCommit", 29: "DescribeAcls", 30: "CreateAcls",
+    31: "DeleteAcls", 32: "DescribeConfigs", 33: "AlterConfigs",
+    34: "AlterReplicaLogDirs", 35: "DescribeLogDirs", 36: "SaslAuthenticate",
+    37: "CreatePartitions", 38: "CreateDelegationToken", 39: "RenewDelegationToken",
+    40: "ExpireDelegationToken", 41: "DescribeDelegationToken", 42: "DeleteGroups",
+    43: "ElectLeaders", 44: "IncrementalAlterConfigs", 45: "AlterPartitionReassignments",
+    46: "ListPartitionReassignments", 47: "OffsetDelete", 48: "DescribeClientQuotas",
+    49: "AlterClientQuotas", 50: "DescribeUserScramCredentials",
+    51: "AlterUserScramCredentials", 56: "AlterIsr", 57: "UpdateFeatures",
+    60: "DescribeCluster", 61: "DescribeProducers", 65: "DescribeTransactions",
+    66: "ListTransactions", 67: "AllocateProducerIds",
+}
+
+
+def _kafka_api_key_name(key: int) -> str:
+    return _KAFKA_APIS.get(key, "Unknown")
+
+
+_MYSQL_COMMANDS = {
+    0: "Sleep", 1: "Quit", 2: "InitDB", 3: "Query", 4: "FieldList", 5: "CreateDB",
+    6: "DropDB", 7: "Refresh", 8: "Shutdown", 9: "Statistics", 10: "ProcessInfo",
+    11: "Connect", 12: "ProcessKill", 13: "Debug", 14: "Ping", 15: "Time",
+    16: "DelayedInsert", 17: "ChangeUser", 18: "BinlogDump", 19: "TableDump",
+    20: "ConnectOut", 21: "RegisterSlave", 22: "StmtPrepare", 23: "StmtExecute",
+    24: "StmtSendLongData", 25: "StmtClose", 26: "StmtReset", 27: "SetOption",
+    28: "StmtFetch", 29: "Daemon", 30: "BinlogDumpGTID", 31: "ResetConnection",
+}
+
+
+def _mysql_command_name(cmd: int) -> str:
+    return _MYSQL_COMMANDS.get(cmd, "Unknown")
+
+
+#: Traffic protocol enum for this framework's socket tracing tables (our own
+#: ordering; reference has an equivalent enum in stirling socket_tracer).
+PROTOCOLS = {
+    0: "unknown", 1: "http", 2: "http2", 3: "mysql", 4: "cql", 5: "pgsql",
+    6: "dns", 7: "redis", 8: "nats", 9: "mux", 10: "kafka", 11: "mongo", 12: "amqp",
+}
+
+
+def _protocol_name(p: int) -> str:
+    return PROTOCOLS.get(p, "unknown")

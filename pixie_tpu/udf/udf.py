@@ -43,9 +43,10 @@ class ScalarUDF:
     out_type: DataType
     fn: Callable
     device: bool = True
-    #: host string fns that take constant (literal) trailing args, e.g.
-    #: contains(col, "lit") — literal args are passed to fn directly.
-    const_args: int = 0
+    #: host fns over a BOUNDED int domain (enum decoders like
+    #: http_resp_message): (lo, hi) inclusive — evaluated once over the domain
+    #: into a device LUT instead of needing a dictionary-encoded input.
+    int_domain: tuple[int, int] | None = None
 
     def key(self) -> tuple:
         return (self.name, self.arg_types)
@@ -217,6 +218,82 @@ class MaxUDA(UDA):
 
     def reduce_ops(self):
         return "max"
+
+    def finalize_host(self, state_np):
+        return np.asarray(state_np)
+
+
+class VarianceUDA(UDA):
+    """Sample variance via (sum, sumsq, count) — trivially psum-mergeable,
+    unlike Welford (reference math_ops.cc uses pairwise-merge Welford because
+    its states merge two at a time; collectives prefer linear state)."""
+
+    name = "variance"
+
+    def out_type(self, in_type):
+        return DataType.FLOAT64
+
+    def init(self, num_groups, in_dtype):
+        # Distinct arrays per leaf: the agg step donates its state buffers, and
+        # aliased leaves would be donated twice.
+        return {
+            "sum": jnp.zeros((num_groups,), dtype=jnp.float64),
+            "sumsq": jnp.zeros((num_groups,), dtype=jnp.float64),
+            "count": jnp.zeros((num_groups,), dtype=jnp.int64),
+        }
+
+    def update(self, state, gid, value, mask, num_groups):
+        from pixie_tpu.ops.groupby import masked_segment_sum
+
+        v = value.astype(jnp.float64)
+        ones = jnp.ones_like(gid, dtype=jnp.int64)
+        return {
+            "sum": state["sum"] + masked_segment_sum(v, gid, num_groups, mask),
+            "sumsq": state["sumsq"] + masked_segment_sum(v * v, gid, num_groups, mask),
+            "count": state["count"] + masked_segment_sum(ones, gid, num_groups, mask),
+        }
+
+    def reduce_ops(self):
+        return {"sum": "add", "sumsq": "add", "count": "add"}
+
+    def finalize_host(self, state_np):
+        n = np.asarray(state_np["count"], dtype=np.float64)
+        s = np.asarray(state_np["sum"])
+        ss = np.asarray(state_np["sumsq"])
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = (ss - s * s / np.where(n > 0, n, 1)) / np.where(n > 1, n - 1, 1)
+        return np.where(n > 1, np.maximum(var, 0.0), np.nan)
+
+
+class StddevUDA(VarianceUDA):
+    name = "stddev"
+
+    def finalize_host(self, state_np):
+        return np.sqrt(super().finalize_host(state_np))
+
+
+class AnyUDA(UDA):
+    """Pick a representative value per group (reference math_ops.cc AnyUDA).
+    Implemented as segment-min, which is a correct 'any' and, unlike
+    'first-seen', is order-independent across shards/batches."""
+
+    name = "any"
+
+    def out_type(self, in_type):
+        return in_type
+
+    def init(self, num_groups, in_dtype):
+        from pixie_tpu.ops.groupby import _identity_for
+
+        return jnp.full((num_groups,), _identity_for(_acc_dtype(in_dtype), "min"))
+
+    def update(self, state, gid, value, mask, num_groups):
+        from pixie_tpu.ops.groupby import masked_segment_min
+
+        return jnp.minimum(state, masked_segment_min(value.astype(state.dtype), gid, num_groups, mask))
+
+    def reduce_ops(self):
+        return "min"
 
     def finalize_host(self, state_np):
         return np.asarray(state_np)

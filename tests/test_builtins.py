@@ -1,0 +1,133 @@
+"""Builtin scalar UDF / UDA behavior tests (reference
+src/carnot/funcs/builtins/*_test.cc)."""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+NOW = 1_700_000_000_000_000_000
+
+
+@pytest.fixture(scope="module")
+def store():
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS),
+        ("s", DT.STRING),
+        ("jsn", DT.STRING),
+        ("sql", DT.STRING),
+        ("status", DT.INT64),
+        ("x", DT.FLOAT64),
+    )
+    t = ts.create("t", rel)
+    t.write({
+        "time_": np.arange(8, dtype=np.int64),
+        "s": ["/api/v1/Go", " ab ", "user@host.com from 10.1.2.3", "xyz",
+              "/api/v1/Go", "42", "-7", "zz"],
+        "jsn": ['{"a": "x", "n": 3, "f": 1.5}', '{"a": "y"}', 'not json', '{}',
+                '[1, 2, 3]', '{"n": "9"}', '{"a": {"b": 1}}', '{"f": "2.5"}'],
+        "sql": ["SELECT * FROM t WHERE id = 42 AND name = 'bob'",
+                "SELECT 1", "INSERT INTO x VALUES (1, 'a')", "", "", "", "", ""],
+        "status": np.array([200, 404, 500, 301, 200, 418, 999, 100], dtype=np.int64),
+        "x": np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0]),
+    })
+    return ts
+
+
+def run1(store, body):
+    src = f"import px\ndf = px.DataFrame(table='t')\n{body}\npx.display(df)"
+    q = compile_pxl(src, store.schemas(), now=NOW)
+    return execute_plan(q.plan, store)["output"].to_pandas()
+
+
+def test_string_fns(store):
+    out = run1(store, "df.u = px.toupper(df.s)\ndf.t = px.trim(df.s)\n"
+                      "df.l = px.length(df.s)\ndf = df['u','t','l']")
+    assert out.u[0] == "/API/V1/GO"
+    assert out.t[1] == "ab"
+    assert out.l[3] == 3
+
+
+def test_strip_prefix_and_substring(store):
+    out = run1(store, "df.p = px.strip_prefix('/api', df.s)\n"
+                      "df.sub = px.substring(df.s, 1, 2)\ndf = df['p','sub']")
+    assert out.p[0] == "/v1/Go"
+    assert out.p[1] == " ab "
+    assert out["sub"][0] == "ap"
+
+
+def test_atoi(store):
+    out = run1(store, "df.i = px.atoi(df.s)\ndf = df[['i']]")
+    assert out.i[5] == 42
+    assert out.i[6] == -7
+    assert out.i[0] == 0
+
+
+def test_regex(store):
+    out = run1(store, "df.m = px.regex_match('/api/.*', df.s)\n"
+                      "df.r = px.replace('[0-9]+', df.s, 'N')\ndf = df['m','r']")
+    assert bool(out.m[0]) and not bool(out.m[1])
+    assert out.r[5] == "N"
+
+
+def test_json_pluck(store):
+    out = run1(store, "df.a = px.pluck(df.jsn, 'a')\ndf.n = px.pluck_int64(df.jsn, 'n')\n"
+                      "df.f = px.pluck_float64(df.jsn, 'f')\ndf = df['a','n','f']")
+    assert out.a[0] == "x"
+    assert out.a[2] == ""
+    assert out.a[6] == '{"b":1}'
+    assert out.n[0] == 3
+    assert out.n[5] == 9
+    assert out.f[0] == 1.5
+    assert out.f[7] == 2.5
+
+
+def test_sql_normalize(store):
+    out = run1(store, "df.q = px.normalize_mysql(df.sql)\ndf = df[['q']]")
+    assert out.q[0] == "SELECT * FROM t WHERE id = ? AND name = ?"
+    assert out.q[2] == "INSERT INTO x VALUES (?, ?)"
+
+
+def test_pii_redaction(store):
+    out = run1(store, "df.red = px.redact_pii_best_effort(df.s)\ndf = df[['red']]")
+    assert out.red[2] == "<REDACTED> from <REDACTED>"
+
+
+def test_http_resp_message_enum(store):
+    out = run1(store, "df.msg = px.http_resp_message(df.status)\ndf = df['status','msg']")
+    got = dict(zip(out.status, out.msg))
+    assert got[200] == "OK"
+    assert got[404] == "Not Found"
+    assert got[418] == "I'm a Teapot"
+    assert got[999] == "Unknown"
+
+
+def test_protocol_enums(store):
+    out = run1(store, "df.k = px.kafka_api_key_name(df.status)\n"
+                      "df.p = px.protocol_name(df.status)\ndf = df['k','p']")
+    assert (out.k == "Unknown").all()  # statuses are all > 67
+    assert (out.p == "unknown").all()
+
+
+def test_stddev_variance_any(store):
+    src = """
+import px
+df = px.DataFrame(table='t')
+out = df.agg(sd=('x', px.stddev), var=('x', px.variance), anyv=('x', px.any))
+px.display(out)
+"""
+    q = compile_pxl(src, store.schemas(), now=NOW)
+    out = execute_plan(q.plan, store)["output"].to_pandas()
+    x = pd.Series(np.arange(1.0, 9.0))
+    np.testing.assert_allclose(out.sd[0], x.std())
+    np.testing.assert_allclose(out["var"][0], x.var())
+    assert out.anyv[0] in set(x)
+
+
+def test_time_casts(store):
+    out = run1(store, "df.t2 = px.int64_to_time(df.status)\ndf = df[['t2']]")
+    assert out.t2[0] == 200

@@ -1,0 +1,362 @@
+"""PxL compiler tests: trace scripts → plan → execute → compare to pandas.
+
+Parity target: reference planner compiler tests
+(src/carnot/planner/compiler/compiler_test.cc) which compile canned queries and
+check plans, plus CarnotTest end-to-end runs.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.metadata import (
+    MetadataStateManager,
+    set_global_manager,
+)
+from pixie_tpu.plan.plan import LimitOp, MapOp, MemorySourceOp
+from pixie_tpu.status import CompilerError
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation, UInt128
+
+N = 4000
+NOW = 1_700_000_000_000_000_000
+
+
+@pytest.fixture(scope="module")
+def upids():
+    return [UInt128.make_upid(1, 100 + i, 5000 + i) for i in range(4)]
+
+
+@pytest.fixture(scope="module")
+def store(upids):
+    rng = np.random.default_rng(3)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS),
+        ("upid", DT.UINT128),
+        ("service", DT.STRING),
+        ("req_path", DT.STRING),
+        ("remote_addr", DT.STRING),
+        ("latency", DT.FLOAT64),
+        ("resp_status", DT.INT64),
+        ("trace_role", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=2048)
+    times = NOW - np.arange(N, dtype=np.int64)[::-1] * 1_000_000
+    t.write(
+        {
+            "time_": times,
+            "upid": rng.choice(upids, N).tolist(),
+            "service": rng.choice(["cart", "checkout", "frontend"], N).tolist(),
+            "req_path": rng.choice(["/api/a", "/api/b", "/healthz"], N).tolist(),
+            "remote_addr": rng.choice(["10.0.0.1", "10.0.0.2", "8.8.8.8"], N).tolist(),
+            "latency": rng.exponential(20.0, N),
+            "resp_status": rng.choice([200, 404, 500], N, p=[0.7, 0.2, 0.1]),
+            "trace_role": rng.choice([1, 2], N),
+        }
+    )
+    return ts
+
+
+@pytest.fixture(scope="module")
+def df(store):
+    t = store.table("http_events")
+    cols = {}
+    for c in t.relation:
+        parts = []
+        for rb, _, _ in t.cursor():
+            arr = rb.columns[c.name][: rb.num_valid]
+            if c.name in t.dictionaries:
+                parts.extend(t.dictionaries[c.name].decode(arr))
+            else:
+                parts.extend(arr.tolist())
+        cols[c.name] = parts
+    return pd.DataFrame(cols)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def k8s_state(upids):
+    mgr = MetadataStateManager(asid=1, node_name="node-1")
+    mgr.apply_updates(
+        [
+            {"kind": "pod", "uid": "pod-uid-0", "name": "cart-abc", "namespace": "shop",
+             "node": "node-1", "ip": "10.0.0.1"},
+            {"kind": "pod", "uid": "pod-uid-1", "name": "checkout-def", "namespace": "shop",
+             "node": "node-1", "ip": "10.0.0.2"},
+            {"kind": "service", "uid": "svc-uid-0", "name": "cart", "namespace": "shop",
+             "cluster_ip": "10.1.0.1", "pod_uids": ["pod-uid-0"]},
+            {"kind": "process", "upid": upids[0], "pod_uid": "pod-uid-0"},
+            {"kind": "process", "upid": upids[1], "pod_uid": "pod-uid-0"},
+            {"kind": "process", "upid": upids[2], "pod_uid": "pod-uid-1"},
+        ]
+    )
+    set_global_manager(mgr)
+    yield
+    set_global_manager(MetadataStateManager())
+
+
+def run(store, src, **kw):
+    q = compile_pxl(src, store.schemas(), now=NOW, **kw)
+    return execute_plan(q.plan, store), q
+
+
+def test_filter_groupby_count(store, df):
+    src = """
+import px
+df = px.DataFrame(table='http_events', start_time='-1h')
+df = df[df.resp_status != 200]
+df = df.groupby(['service', 'resp_status']).agg(cnt=('latency', px.count))
+px.display(df, 'out')
+"""
+    res, _ = run(store, src)
+    out = res["out"].to_pandas().sort_values(["service", "resp_status"]).reset_index(drop=True)
+    exp = (
+        df[df.resp_status != 200]
+        .groupby(["service", "resp_status"], as_index=False)
+        .size()
+        .rename(columns={"size": "cnt"})
+        .sort_values(["service", "resp_status"])
+        .reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(
+        out[["service", "resp_status", "cnt"]], exp[["service", "resp_status", "cnt"]],
+        check_dtype=False,
+    )
+
+
+def test_column_assignment_and_projection(store, df):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df.latency_ms = df.latency / 1000.0
+df.is_error = df.resp_status >= 400
+df = df['time_', 'service', 'latency_ms', 'is_error']
+px.display(df)
+"""
+    res, q = run(store, src)
+    out = res["output"].to_pandas()
+    assert list(out.columns) == ["time_", "service", "latency_ms", "is_error"]
+    np.testing.assert_allclose(
+        np.sort(out.latency_ms.values), np.sort(df.latency.values / 1000.0)
+    )
+    assert out.is_error.sum() == (df.resp_status >= 400).sum()
+    # map fusion: assignments + projection collapse into ONE map
+    maps = [o for o in q.plan.ops() if isinstance(o, MapOp)]
+    assert len(maps) == 1
+
+
+def test_column_pruning_narrows_source(store):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(cnt=('latency', px.count))
+px.display(df)
+"""
+    _, q = run(store, src)
+    srcs = [o for o in q.plan.ops() if isinstance(o, MemorySourceOp)]
+    assert srcs[0].columns == ["service"]
+
+
+def test_ctx_metadata(store, df, upids):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df.pod = df.ctx['pod']
+df.pid = px.upid_to_pid(df.upid)
+df = df.groupby('pod').agg(cnt=('time_', px.count))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    out = res["output"].to_pandas()
+    pods = dict(zip(out.pod, out.cnt))
+    upid_pod = {upids[0]: "shop/cart-abc", upids[1]: "shop/cart-abc",
+                upids[2]: "shop/checkout-def", upids[3]: ""}
+    exp = df.upid.map(upid_pod).value_counts().to_dict()
+    assert pods == exp
+
+
+def test_select_and_string_fns(store, df):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df.bucket = px.select(df.resp_status >= 400, 'error', 'ok')
+df = df[px.contains(df.req_path, 'api')]
+df = df.groupby('bucket').agg(cnt=('time_', px.count))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    out = res["output"].to_pandas()
+    sub = df[df.req_path.str.contains("api")]
+    exp = np.where(sub.resp_status >= 400, "error", "ok")
+    assert dict(zip(out.bucket, out.cnt)) == pd.Series(exp).value_counts().to_dict()
+
+
+def test_head_and_default_limit(store):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.head(17)
+px.display(df)
+"""
+    res, _ = run(store, src)
+    assert res["output"].num_rows == 17
+
+    src2 = """
+import px
+df = px.DataFrame(table='http_events')
+px.display(df)
+"""
+    q = compile_pxl(src2, store.schemas(), now=NOW, default_limit=100)
+    limits = [o for o in q.plan.ops() if isinstance(o, LimitOp)]
+    assert limits and limits[0].n == 100
+    res2 = execute_plan(q.plan, store)
+    assert res2["output"].num_rows == 100
+
+
+def test_merge_and_agg_math(store, df):
+    # net_flow_graph-style: agg twice, then broadcast-join a 1-row frame.
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+tw = df.agg(t_min=('time_', px.min), t_max=('time_', px.max))
+tw.join_key = 1
+tw.span = tw.t_max - tw.t_min
+stats = df.groupby('service').agg(total=('latency', px.sum), cnt=('time_', px.count))
+stats.join_key = 1
+out = stats.merge(tw, how='inner', left_on='join_key', right_on='join_key')
+out = out.drop(['join_key_x', 'join_key_y', 't_min', 't_max'])
+px.display(out)
+"""
+    res, _ = run(store, src)
+    out = res["output"].to_pandas().sort_values("service").reset_index(drop=True)
+    exp = (
+        df.groupby("service", as_index=False)
+        .agg(total=("latency", "sum"), cnt=("time_", "count"))
+        .sort_values("service")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out.total.values, exp.total.values)
+    span = df.time_.max() - df.time_.min()
+    assert (out.span == span).all()
+
+
+def test_append_union(store, df):
+    src = """
+import px
+a = px.DataFrame(table='http_events')
+a = a[a.resp_status == 200]
+b = px.DataFrame(table='http_events')
+b = b[b.resp_status == 500]
+u = a.append(b)
+u = u.groupby('resp_status').agg(cnt=('time_', px.count))
+px.display(u)
+"""
+    res, _ = run(store, src)
+    out = res["output"].to_pandas()
+    exp = df[df.resp_status.isin([200, 500])].resp_status.value_counts().to_dict()
+    assert dict(zip(out.resp_status, out.cnt)) == exp
+
+
+def test_rolling_windowed_agg(store, df):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.rolling('1s').groupby('service').agg(cnt=('time_', px.count))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    out = res["output"].to_pandas()
+    win = 1_000_000_000
+    exp = (
+        df.assign(w=(df.time_ // win) * win)
+        .groupby(["w", "service"], as_index=False)
+        .size()
+    )
+    assert out.cnt.sum() == len(df)
+    assert len(out) == len(exp)
+
+
+def test_function_script_with_args(store):
+    src = """
+import px
+
+def http_data(start_time: str, status_min: int, num_head: int):
+    df = px.DataFrame(table='http_events', start_time=start_time)
+    df = df[df.resp_status >= status_min]
+    df = df.head(num_head)
+    return df
+"""
+    q = compile_pxl(
+        src,
+        store.schemas(),
+        now=NOW,
+        func="http_data",
+        func_args={"start_time": "-30m", "status_min": "400", "num_head": "25"},
+    )
+    res = execute_plan(q.plan, store)
+    out = res["output"].to_pandas()
+    assert len(out) == 25
+    assert (out.resp_status >= 400).all()
+
+
+def test_time_range(store, df):
+    src = """
+import px
+df = px.DataFrame(table='http_events', start_time='-1s')
+df = df.agg(cnt=('time_', px.count))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    cnt = int(res["output"].to_pandas().cnt[0])
+    exp = (df.time_ >= NOW - 1_000_000_000).sum()
+    assert cnt == exp
+
+
+def test_left_join_null_keys_dropped_in_groupby(store):
+    # Unmatched left-join rows fill string columns with null (code -1); a
+    # subsequent groupby must drop them, not fold them into group 0.
+    from pixie_tpu.table import TableStore as TS
+
+    ts = TS()
+    lrel = Relation.of(("time_", DT.TIME64NS), ("k", DT.STRING))
+    rrel = Relation.of(("k", DT.STRING), ("owner", DT.STRING))
+    ts.create("l", lrel).write({"time_": np.arange(3, dtype=np.int64),
+                                "k": ["a", "b", "c"]})
+    ts.create("r", rrel).write({"k": ["a"], "owner": ["team-x"]})
+    src = """
+import px
+l = px.DataFrame(table='l')
+r = px.DataFrame(table='r')
+j = l.merge(r, how='left', left_on='k', right_on='k')
+out = j.groupby('owner').agg(cnt=('time_', px.count))
+px.display(out)
+"""
+    q = compile_pxl(src, ts.schemas(), now=NOW)
+    res = execute_plan(q.plan, ts)
+    out = res["output"].to_pandas()
+    assert dict(zip(out.owner, out.cnt)) == {"team-x": 1}
+
+
+def test_min_time_keeps_time_dtype(store):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.agg(first=('time_', px.min))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    assert res["output"].relation.dtype("first") == DT.TIME64NS
+
+
+def test_errors(store):
+    with pytest.raises(CompilerError):
+        compile_pxl("import px\ndf = px.DataFrame(table='nope')\npx.display(df)",
+                    store.schemas(), now=NOW)
+    with pytest.raises(CompilerError):
+        compile_pxl("import px\nx = 1\n", store.schemas(), now=NOW)
+    with pytest.raises(CompilerError):
+        compile_pxl(
+            "import px\ndf = px.DataFrame(table='http_events')\n"
+            "df = df[df.latency]\npx.display(df)",
+            store.schemas(), now=NOW)

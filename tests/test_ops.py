@@ -152,5 +152,5 @@ class TestRegistry:
 
     def test_host_string(self):
         f = registry.scalar("contains", (DT.STRING, DT.STRING))
-        assert not f.device and f.const_args == 1
+        assert not f.device
         assert f.fn("hello world", "wor") is True
