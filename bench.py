@@ -127,7 +127,7 @@ def main():
     rows_per_sec = rows / best
     assert out.num_rows > 0
 
-    base = pandas_baseline(ts, repeats=1)
+    base = pandas_baseline(ts, repeats=3)
     print(
         json.dumps(
             {

@@ -12,7 +12,6 @@ Scripts come in two shapes (mirroring the bundled pxl_scripts):
 from __future__ import annotations
 
 import dataclasses
-import sys
 import threading
 from typing import Optional
 
@@ -25,6 +24,36 @@ from pixie_tpu.status import CompilerError
 from pixie_tpu.types import Relation
 
 _exec_lock = threading.Lock()
+
+#: Builtins exposed to PxL scripts.  PxL is a restricted dialect — scripts are
+#: query text, not trusted host code (the reference parses PxL in its own C++
+#: front end for the same reason).  This is defense-in-depth, not a sandbox:
+#: no file/process/import machinery, just the pure helpers scripts reasonably
+#: use.  `__import__` is allowed solely for `import px`.
+_SAFE_BUILTIN_NAMES = [
+    "abs", "all", "any", "bool", "dict", "divmod", "enumerate", "filter",
+    "float", "format", "frozenset", "hash", "int", "isinstance", "issubclass",
+    "iter", "len", "list", "map", "max", "min", "next", "print", "range",
+    "repr", "reversed", "round", "set", "slice", "sorted", "str", "sum",
+    "tuple", "zip", "True", "False", "None", "ValueError", "TypeError",
+    "KeyError", "Exception",
+]
+
+
+def _safe_builtins(px_module) -> dict:
+    import builtins as _b
+
+    def _import(name, globals=None, locals=None, fromlist=(), level=0):
+        if name == "px":
+            return px_module
+        raise ImportError(
+            f"PxL scripts may only `import px` (attempted {name!r})"
+        )
+
+    out = {n: getattr(_b, n) for n in _SAFE_BUILTIN_NAMES if hasattr(_b, n)}
+    out["__import__"] = _import
+    out["__build_class__"] = _b.__build_class__
+    return out
 
 
 @dataclasses.dataclass
@@ -63,34 +92,25 @@ def compile_pxl(
         registry = registry_mod
     ctx = CompileCtx(schemas, registry, now if now is not None else timeparse.now_ns())
     px = PxModule(ctx)
-    glb: dict = {"__name__": "pxl_script", "px": px, "__builtins__": __builtins__}
+    glb: dict = {"__name__": "pxl_script", "px": px, "__builtins__": _safe_builtins(px)}
 
     # dont_inherit: this module uses `from __future__ import annotations`, which
     # compile() would otherwise leak into the script, stringifying the typed
     # function parameters we coerce below.
     code = compile(source, "<pxl>", "exec", dont_inherit=True)
-    # `import px` inside scripts resolves via sys.modules; compilation is
-    # serialized so concurrent queries don't see each other's module instance.
-    with _exec_lock:
-        saved = sys.modules.get("px")
-        sys.modules["px"] = px
-        try:
-            exec(code, glb)
-            result_df = None
-            if func is not None:
-                fn = glb.get(func)
-                if fn is None or not callable(fn):
-                    raise CompilerError(f"script has no function {func!r}")
-                anns = getattr(fn, "__annotations__", {})
-                kwargs = {}
-                for k, v in (func_args or {}).items():
-                    kwargs[k] = _coerce_arg(v, anns.get(k))
-                result_df = fn(**kwargs)
-        finally:
-            if saved is not None:
-                sys.modules["px"] = saved
-            else:
-                sys.modules.pop("px", None)
+    # `import px` resolves through the restricted __import__ hook to THIS
+    # compilation's module instance — no sys.modules juggling needed.
+    exec(code, glb)
+    result_df = None
+    if func is not None:
+        fn = glb.get(func)
+        if fn is None or not callable(fn):
+            raise CompilerError(f"script has no function {func!r}")
+        anns = getattr(fn, "__annotations__", {})
+        kwargs = {}
+        for k, v in (func_args or {}).items():
+            kwargs[k] = _coerce_arg(v, anns.get(k))
+        result_df = fn(**kwargs)
 
     if isinstance(result_df, DataFrame) and not ctx.sinks:
         result_df.display("output")

@@ -125,6 +125,11 @@ def prune_columns(plan: Plan) -> Plan:
             merge(parents[0].id, req)
         elif isinstance(op, MapOp):
             kept = op.exprs if my_need is None else [(n, e) for n, e in op.exprs if n in my_need]
+            # Nothing required (e.g. a nullary-count agg downstream): keep one
+            # column anyway so batches have a length — and REGISTER its inputs
+            # upstream, or the rebuild fallback would reference pruned columns.
+            if not kept:
+                kept = op.exprs[:1]
             req: set = set()
             for _, e in kept:
                 _cols_of(e, req)
@@ -145,6 +150,8 @@ def prune_columns(plan: Plan) -> Plan:
                 if my_need is None
                 else [t for t in op.output if t[2] in my_need]
             )
+            if not kept:
+                kept = op.output[:1]
             lreq = {c for s, c, _ in kept if s == "left"} | set(op.left_on)
             rreq = {c for s, c, _ in kept if s == "right"} | set(op.right_on)
             merge(parents[0].id, lreq)

@@ -259,10 +259,17 @@ class DataFrame:
             object.__setattr__(self, name, value)
             return
         s = as_scalar(value, self)
-        exprs = [(n, Column(n)) for n in self._schema if n != name]
-        exprs.append((name, s.expr))
-        schema = {n: self._schema[n] for n in self._schema if n != name}
-        schema[name] = s.dtype
+        # Reassignment keeps the column's position (pandas/PxL column order);
+        # a new column appends.
+        exprs = [
+            (n, s.expr if n == name else Column(n)) for n in self._schema
+        ]
+        schema = {
+            n: (s.dtype if n == name else self._schema[n]) for n in self._schema
+        }
+        if name not in self._schema:
+            exprs.append((name, s.expr))
+            schema[name] = s.dtype
         node = self._ctx.plan.add(MapOp(exprs=exprs), parents=[self._node])
         # In-place update (PxL assignment semantics).
         object.__setattr__(self, "_node", node)

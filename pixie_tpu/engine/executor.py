@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pixie_tpu.engine.eval import ExprCompiler, SVal, apply_lut, apply_lut_np
+from pixie_tpu.engine import transfer
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.plan.plan import (
     AggOp,
@@ -56,6 +57,88 @@ INT64_MIN = np.iinfo(np.int64).min
 INT64_MAX = np.iinfo(np.int64).max
 MAX_GROUPS = 1 << 22
 MIN_BUCKET = 1 << 10
+#: Feed coalescing target: sealed storage batches (64K-ish, the reference's
+#: compaction granularity) are merged into large device feeds so a typical
+#: query is ONE device execution.  Sized at 16M rows (~0.5 GB at 32 B/row)
+#: because on remote/tunneled runtimes each execution has a large fixed cost —
+#: fewer, bigger launches win decisively over streaming many small batches.
+FEED_ROWS = 1 << 24
+
+
+# -------------------------------------------------------------- kernel cache
+# Compiled chain kernels are reused across queries (the reference re-walks its
+# exec-node tree per query; we must NOT re-jit per query or XLA compile time
+# dominates).  Sound because cache keys capture everything baked into a kernel:
+# the chain structure, input dtypes, and (id, size) of every input dictionary —
+# dictionaries are append-only, so same (id, size) ⇒ identical content ⇒
+# identical LUTs.  Data-dependent aggregation state (intdevice key sets, window
+# origins) is covered by including the table's rows_written in agg signatures.
+import collections as _collections
+import json as _json
+
+_KERNEL_CACHE: "_collections.OrderedDict[str, tuple]" = _collections.OrderedDict()
+_KERNEL_CACHE_MAX = 128
+
+
+def _cache_get(sig):
+    if sig is None:
+        return None
+    got = _KERNEL_CACHE.get(sig)
+    if got is not None:
+        _KERNEL_CACHE.move_to_end(sig)
+    return got
+
+
+def _cache_put(sig, value):
+    if sig is None:
+        return
+    _KERNEL_CACHE[sig] = value
+    while len(_KERNEL_CACHE) > _KERNEL_CACHE_MAX:
+        _KERNEL_CACHE.popitem(last=False)
+
+
+def _op_sig(op) -> dict:
+    d = op.to_dict()
+    d.pop("id", None)
+    return d
+
+
+# ------------------------------------------------------------ device feed cache
+# The TPU-native analog of the reference's cold store (table/table.h hot/cold
+# partitions): sealed batches are immutable, so their assembled, padded device
+# feeds are cached in HBM keyed by the seal gens.  Repeat queries then stream
+# ZERO bytes host→device — essential when the chip is remote (tunneled PCIe/DCN
+# transfers run at ~100 MB/s and would dominate every query).
+import os as _os
+
+_DEVICE_CACHE: "_collections.OrderedDict[tuple, dict]" = _collections.OrderedDict()
+_DEVICE_CACHE_BYTES = 0
+_DEVICE_CACHE_MAX = int(_os.environ.get("PIXIE_TPU_DEVICE_CACHE_MB", "4096")) << 20
+
+
+def _device_cache_get(key):
+    got = _DEVICE_CACHE.get(key)
+    if got is not None:
+        _DEVICE_CACHE.move_to_end(key)
+    return got
+
+
+def _device_cache_put(key, cols: dict):
+    global _DEVICE_CACHE_BYTES
+    nbytes = sum(v.nbytes for v in cols.values())
+    if nbytes > _DEVICE_CACHE_MAX:
+        return
+    _DEVICE_CACHE[key] = cols
+    _DEVICE_CACHE_BYTES += nbytes
+    while _DEVICE_CACHE_BYTES > _DEVICE_CACHE_MAX and _DEVICE_CACHE:
+        _k, v = _DEVICE_CACHE.popitem(last=False)
+        _DEVICE_CACHE_BYTES -= sum(x.nbytes for x in v.values())
+
+
+def clear_device_cache():
+    global _DEVICE_CACHE_BYTES
+    _DEVICE_CACHE.clear()
+    _DEVICE_CACHE_BYTES = 0
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -86,17 +169,20 @@ class HostBatch:
 @dataclasses.dataclass
 class GroupKey:
     name: str
-    kind: str  # "dict" | "intdict" | "window"
+    kind: str  # "dict" | "intdevice" | "window"
     card: int  # pow2-bucketed static cardinality
     out_dtype: DT
-    dictionary: Optional[Dictionary] = None  # dict/intdict
-    #: source column the feed path reads for intdict encoding (differs from
-    #: `name` when a Map renamed the column).
+    dictionary: Optional[Dictionary] = None  # dict/intdevice
+    #: source column the intdevice key reads (differs from `name` when a Map
+    #: renamed the column).
     src_name: str = ""
     # window params
     width: int = 0
     t0_bin: int = 0
     key_sval: Optional[SVal] = None  # device codes builder (dict/window)
+    #: luts entry holding the sorted unique values (intdevice: in-kernel
+    #: searchsorted replaces host-side per-batch encoding).
+    lut_name: str = ""
 
 
 class _ChainCtx:
@@ -218,7 +304,9 @@ class ChainKernel:
 
     def make_output_step(self, out_names: list[str]):
         """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts)
-        → (out_cols, mask, count). Also returns (dtypes, dicts) of outputs."""
+        → (out_cols, count, consumed) with selected rows COMPACTED to the front
+        on device (stable partition by mask), so the host can read back exactly
+        `count` rows. Also returns (dtypes, dicts) of outputs."""
         sym = self.ctx.sym
         missing = [n for n in out_names if n not in sym]
         if missing:
@@ -232,24 +320,70 @@ class ChainKernel:
             n = _first_len(cols)
             mask = self._base_mask(env, n, n_valid, t_lo, t_hi)
             mask, consumed = self._apply_steps(env, mask, limit_remaining)
+            # Stable front-compaction: selected rows keep order at the front.
+            order = jnp.argsort(jnp.logical_not(mask), stable=True)
             outs = {}
             for name, b in builders:
                 v = b(env)
-                outs[name] = jnp.broadcast_to(v, (n,)) if v.ndim == 0 else v
-            return outs, mask, jnp.sum(mask.astype(jnp.int64)), consumed
+                v = jnp.broadcast_to(v, (n,)) if v.ndim == 0 else v
+                outs[name] = jnp.take(v, order)
+            return outs, jnp.sum(mask.astype(jnp.int64)), consumed
 
         return jax.jit(step), out_dtypes, out_dicts
 
-    def make_agg_step(self, keys: list[GroupKey], udas: list, num_groups: int):
+    def make_partial_agg_step(self, keys, udas, num_groups: int, init_specs):
+        """→ jit fn(cols, n_valid, t_lo, t_hi, luts) → partial state.
+
+        Identity state is created INSIDE the trace, so per-feed calls are
+        mutually independent — crucial on runtimes where dependent executions
+        serialize (each feed's partial dispatches without waiting).  Pair with
+        `make_merge_states` to combine the partials in one stacked reduction.
+        """
+        raw = self.make_agg_step(keys, udas, num_groups, jit=False)
+        spec = list(init_specs)
+
+        def step(cols, n_valid, t_lo, t_hi, luts):
+            state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in spec}
+            new_state, _cnt, _consumed = raw(
+                cols, n_valid, t_lo, t_hi, jnp.int64(INT64_MAX), luts, state
+            )
+            return new_state
+
+        return jax.jit(step)
+
+    @staticmethod
+    def make_merge_states(udas):
+        """→ jit fn(*states) → merged state, as ONE stacked reduction per leaf
+        (flat dependency graph: N partials merge in a single execution)."""
+        reduce_tree = {name: uda.reduce_ops() for name, uda, _vb in udas}
+        fns = {"add": (lambda s: jnp.sum(s, axis=0)),
+               "min": (lambda s: jnp.min(s, axis=0)),
+               "max": (lambda s: jnp.max(s, axis=0))}
+
+        def merge(*states):
+            return jax.tree.map(
+                lambda op, *leaves: fns[op](jnp.stack(leaves)),
+                reduce_tree,
+                *states,
+                is_leaf=lambda x: isinstance(x, str),
+            )
+
+        return jax.jit(merge)
+
+    def make_agg_step(self, keys: list[GroupKey], udas: list, num_groups: int, jit: bool = True):
         """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts, state)
         → (state, count). udas: list of (out_name, UDA, value_builder|None)."""
         from pixie_tpu.ops.groupby import combine_codes
 
         key_builders = []
         for k in keys:
-            if k.kind == "intdict":
-                pseudo = f"__qcode__{k.name}"
-                key_builders.append(lambda env, pseudo=pseudo: env["cols"][pseudo])
+            if k.kind == "intdevice":
+                src_name, lut_name = k.src_name, k.lut_name
+                key_builders.append(
+                    lambda env, s=src_name, l=lut_name: jnp.searchsorted(
+                        env["luts"][l], env["cols"][s]
+                    ).astype(jnp.int32)
+                )
             elif k.kind == "dict":
                 key_builders.append(k.key_sval.build)
             else:  # window
@@ -271,7 +405,7 @@ class ChainKernel:
                 # of the aggregate (pandas dropna semantics); without this,
                 # combine_codes would clamp them into group 0.
                 for k, c in zip(keys, code_arrays):
-                    if k.kind in ("dict", "intdict"):
+                    if k.kind == "dict":
                         mask = mask & (c >= 0)
                 gid, _ = combine_codes(code_arrays, cards)
             else:
@@ -288,6 +422,8 @@ class ChainKernel:
         # Kept unjitted for the SPMD lifter (parallel.spmd.spmd_agg_step wraps it
         # in shard_map over a mesh axis).
         self.raw_agg_step = step
+        if not jit:
+            return step
         return jax.jit(step, donate_argnums=(6,))
 
 
@@ -345,29 +481,77 @@ class PlanExecutor:
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
     # ------------------------------------------------------------- stream feed
-    def _feed(self, src, names, keys_intdict, cap):
-        """Yield (cols np dict padded, n_valid) host batches."""
+    def _feed(self, src, names, cap):
+        """Yield (cols np dict padded, n_valid) host batches.
+
+        Cursor batches (storage granularity) are coalesced into ~FEED_ROWS
+        device feeds: fewer kernel dispatches and transfers, and the bucketed
+        shapes repeat so XLA's shape cache stays warm.
+        """
         if isinstance(src, HostBatch):
             n = src.num_rows
             # Materialized intermediates can exceed the stream cap (e.g. many
             # groups out of an agg): bucket to their own pow2 size.
             bucket = max(MIN_BUCKET, next_pow2(max(n, 1)))
             cols = {k: _pad(src.cols[k], bucket) for k in names}
-            for gk in keys_intdict:
-                codes = gk.dictionary.encode(src.cols[gk.src_name])
-                cols[f"__qcode__{gk.name}"] = _pad(codes, bucket)
             yield cols, n
             return
-        for rb, _row_id, _gen in src:  # cursor
+
+        target = max(cap, FEED_ROWS)
+        table_id = src.table.uid
+
+        def emit(parts, gens, n):
+            # Sealed-only feeds are immutable → serve/place them from the HBM
+            # feed cache; anything touching the hot remainder streams fresh.
+            cacheable = all(g is not None for g in gens)
+            dkey = (table_id, tuple(gens), tuple(names)) if cacheable else None
+            if dkey is not None:
+                cached = _device_cache_get(dkey)
+                if cached is not None:
+                    self.stats["feed_cache_hits"] = self.stats.get("feed_cache_hits", 0) + 1
+                    return dict(cached), n
+            # Single-copy assembly: write every storage batch straight into the
+            # padded bucket buffer (concatenate-then-pad would copy twice).
+            # The bucket must hold n even when accumulation overshot `target`
+            # (storage batch sizes don't necessarily divide the feed target).
+            bucket = max(_bucket(n, target), next_pow2(max(n, 1)))
+            cols = {}
+            for k in names:
+                first = parts[0][k]
+                buf = np.zeros(bucket, dtype=first.dtype)
+                off = 0
+                for p in parts:
+                    a = p[k]
+                    buf[off : off + len(a)] = a
+                    off += len(a)
+                cols[k] = buf
+            if dkey is not None:
+                dev = jax.device_put(cols)
+                _device_cache_put(dkey, dev)
+                cols = dict(dev)
+            return cols, n
+
+        pend, gens, nrows = [], [], 0
+        for rb, _row_id, gen in src:  # cursor
             n = rb.num_valid
-            bucket = _bucket(rb.num_rows, cap)
-            cols = {k: _pad(rb.columns[k][: rb.num_rows], bucket) for k in names}
-            for gk in keys_intdict:
-                codes = gk.dictionary.encode(rb.columns[gk.src_name][:n])
-                cols[f"__qcode__{gk.name}"] = _pad(codes, bucket)
+            if n == 0:
+                continue
+            # The hot remainder (gen None) must not join a sealed feed: sealed
+            # feeds are immutable and HBM-cached, the hot tail changes every
+            # write — mixing them would force a full re-upload per query.
+            if gen is None and pend:
+                yield emit(pend, gens, nrows)
+                pend, gens, nrows = [], [], 0
+            pend.append({k: rb.columns[k][:n] for k in names})
+            gens.append(gen)
+            nrows += n
             self.stats["rows_scanned"] += n
             self.stats["batches"] += 1
-            yield cols, n
+            if nrows >= target:
+                yield emit(pend, gens, nrows)
+                pend, gens, nrows = [], [], 0
+        if pend:
+            yield emit(pend, gens, nrows)
 
     # ---------------------------------------------------------------- blocking
     def _eval_blocking(self, op) -> HostBatch:
@@ -387,35 +571,106 @@ class PlanExecutor:
         self._materialized[op.id] = out
         return out
 
+    def _chain_cache_sig(
+        self, head, chain, dtypes, dicts, extra, include_times: bool = False
+    ) -> Optional[str]:
+        """Cache signature for a kernel over this chain; None = not cacheable.
+
+        Only table-headed chains are cached: their dictionaries are append-only,
+        so (id, size) pins exact content (the table uid keeps id() stable).
+        Blocking-op intermediates get fresh dictionaries per query and must not
+        be cached.  Source time bounds are RUNTIME args (t_lo/t_hi), so they are
+        excluded from the signature unless the kernel bakes them (window aggs) —
+        otherwise every '-5m'-style relative query would re-jit.
+        """
+        if not isinstance(head, MemorySourceOp):
+            return None
+        table = self.store.table(head.table)
+        src_sig = _op_sig(head)
+        if not include_times:
+            src_sig.pop("start_time", None)
+            src_sig.pop("stop_time", None)
+        key = {
+            "reg": id(self.registry),
+            "table": (head.table, table.uid),
+            "src": src_sig,
+            "chain": [_op_sig(op) for op in chain],
+            "dtypes": {n: int(t) for n, t in dtypes.items()},
+            "dicts": {n: (id(d), d.size) for n, d in dicts.items()},
+            "extra": extra,
+        }
+        return _json.dumps(key, sort_keys=True, default=str)
+
     def _consume_chain(self, terminal_parent, out_names=None):
         """Run the chain feeding `terminal_parent` through an output step.
 
         Returns (out_dtypes, out_dicts, iterator of (np_cols, np_mask)).
         """
         head, chain = self._upstream_chain(terminal_parent)
+
+        # Fast path: a bare blocking op feeding a sink (the common shape for
+        # aggregated results) is already a host batch — plain column selection,
+        # no kernel (and no per-query XLA compile of a trivial projection).
+        if not chain and not isinstance(head, MemorySourceOp):
+            hb = self._eval_blocking(head)
+            sel = out_names if out_names is not None else list(hb.cols)
+            missing = [n for n in sel if n not in hb.cols]
+            if missing:
+                raise CompilerError(f"output columns {missing} not found")
+            out_dtypes = {n: hb.dtypes[n] for n in sel}
+            out_dicts = {n: hb.dicts[n] for n in sel if n in hb.dicts}
+
+            def gen_direct():
+                yield {n: hb.cols[n] for n in sel}, hb.num_rows
+
+            return out_dtypes, out_dicts, sel, gen_direct()
+
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
-        kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
-        if out_names is None:
-            out_names = list(kern.ctx.visible)
-        step, out_dtypes, out_dicts = kern.make_output_step(out_names)
+        sig = self._chain_cache_sig(
+            head, chain, dtypes, dicts,
+            ("out", tuple(out_names) if out_names is not None else None),
+        )
+        cached = _cache_get(sig)
+        if cached is not None:
+            kern, step, out_dtypes, out_dicts, out_names = cached
+        else:
+            kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
+            if out_names is None:
+                out_names = list(kern.ctx.visible)
+            step, out_dtypes, out_dicts = kern.make_output_step(out_names)
+            _cache_put(sig, (kern, step, out_dtypes, out_dicts, out_names))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
         limit_total = _chain_limit(chain)
-        has_limit = limit_total < INT64_MAX
 
         def gen():
-            remaining = limit_total
-            for cols, n_valid in self._feed(src, names, [], cap):
-                outs, mask, cnt, consumed = step(
-                    cols, np.int64(n_valid), t_lo, t_hi, np.int64(remaining), luts
+            # Fully async pipeline: dispatch every feed's step with the limit
+            # budget carried as a DEVICE scalar (no per-feed host sync), then
+            # exactly two round-trips — one packed pull of the row counts, one
+            # packed pull of the count-sliced outputs.  With a remote TPU each
+            # readback costs a fixed RTT, so per-feed pulls would dominate.
+            has_limit = limit_total < INT64_MAX
+            remaining = jnp.asarray(limit_total, dtype=jnp.int64)
+            feeds = []
+            for cols, n_valid in self._feed(src, names, cap):
+                outs, cnt, consumed = step(
+                    cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
                 )
-                cnt = int(cnt)
-                mask_np = np.asarray(mask)
-                yield {k: np.asarray(v)[mask_np] for k, v in outs.items()}, cnt
                 if has_limit:
-                    remaining -= int(consumed)
-                    if remaining <= 0:
-                        break
+                    # Only limit queries need the budget threaded (chains the
+                    # per-feed executions); unlimited scans stay independent.
+                    remaining = remaining - consumed
+                feeds.append((outs, cnt))
+            if not feeds:
+                return
+            cnts = transfer.pull([c for _, c in feeds])
+            sliced = [
+                {k: v[: int(c)] for k, v in outs.items()}
+                for (outs, _), c in zip(feeds, cnts)
+            ]
+            pulled = transfer.pull(sliced)
+            for cols_np, c in zip(pulled, cnts):
+                yield cols_np, int(c)
 
         return out_dtypes, out_dicts, out_names, gen()
 
@@ -477,16 +732,23 @@ class PlanExecutor:
                         "columns, dictionary columns and px.bin() windows can be "
                         "grouped in this version"
                     )
+                # Device-side encoding: one prescan finds the uniques (sorted,
+                # so dictionary code == sorted position); the kernel then maps
+                # value→code with a searchsorted against a small device array —
+                # no per-batch host encode (the former 'intdict' hot-loop cost).
                 qd = Dictionary()
-                _prescan_unique(src, prov.name, qd)
+                _prescan_unique(src, prov.name, qd, sort=True)
+                vals = np.asarray(qd.values(), dtype=np.int64)
+                lut_name = kern.ctx.ec._add_lut(vals)
                 keys.append(
                     GroupKey(
                         name,
-                        "intdict",
+                        "intdevice",
                         next_pow2(max(qd.size, 1)),
                         sv.dtype,
                         qd,
                         src_name=prov.name,
+                        lut_name=lut_name,
                     )
                 )
                 continue
@@ -504,59 +766,98 @@ class PlanExecutor:
     def _run_agg(self, op: AggOp) -> HostBatch:
         head, chain = self._upstream_chain(self.plan.parents(op)[0])
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
-        kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
-        keys = self._plan_group_keys(op, kern, src, head)
-        num_groups = 1
-        for k in keys:
-            num_groups *= k.card
 
-        # UDA instances + value builders (+ implicit row counter for seen-groups).
-        udas = []
-        state = {}
-        seen_name = "__seen"
-        from pixie_tpu.udf.udf import CountUDA
+        # Agg kernels bake data-dependent key sets (intdevice uniques, window
+        # origins) unless every group key is dictionary-backed; cover that with
+        # the table's rows_written in the signature.
+        sig = None
+        if isinstance(head, MemorySourceOp):
+            extra = ["agg", _op_sig(op)]
+            data_dependent = not all(g in dicts for g in op.groups)
+            if data_dependent:
+                # intdevice key sets / window origins bake data; rows_written
+                # pins the snapshot, and window t0_bin depends on the bounds.
+                extra.append(self.store.table(head.table).stats()["rows_written"])
+            sig = self._chain_cache_sig(
+                head, chain, dtypes, dicts, extra, include_times=data_dependent
+            )
+        cached = _cache_get(sig)
+        if cached is not None:
+            (kern, keys, udas, in_types, init_specs, num_groups,
+             seen_name, step, partial_step, merge_fn) = cached
+            state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
+        else:
+            kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
+            keys = self._plan_group_keys(op, kern, src, head)
+            num_groups = 1
+            for k in keys:
+                num_groups *= k.card
 
-        in_types: dict[str, DT | None] = {}
-        for ae in [*op.values]:
-            uda = self.registry.uda(ae.fn)
-            vb = None
-            in_dtype = None
-            in_types[ae.out_name] = None
-            if ae.arg is not None:
-                sv = kern.ctx.sym.get(ae.arg)
-                if sv is None:
-                    raise CompilerError(f"agg input column {ae.arg!r} not found")
-                if sv.dictionary is not None:
-                    raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
-                vb = sv.build
-                in_dtype = STORAGE_DTYPE[sv.dtype]
-                in_types[ae.out_name] = sv.dtype
-            elif not uda.nullary:
-                raise CompilerError(f"aggregate {ae.fn} requires an input column")
-            udas.append((ae.out_name, uda, vb))
-            state[ae.out_name] = uda.init(num_groups, in_dtype)
-        seen_uda = CountUDA()
-        udas.append((seen_name, seen_uda, None))
-        state[seen_name] = seen_uda.init(num_groups)
+            # UDA instances + value builders (+ implicit row counter for
+            # seen-groups).
+            udas = []
+            init_specs = []
+            state = {}
+            seen_name = "__seen"
+            from pixie_tpu.udf.udf import CountUDA
 
-        step = kern.make_agg_step(keys, udas, num_groups)
+            in_types: dict[str, DT | None] = {}
+            for ae in [*op.values]:
+                uda = self.registry.uda(ae.fn)
+                vb = None
+                in_dtype = None
+                in_types[ae.out_name] = None
+                if ae.arg is not None:
+                    sv = kern.ctx.sym.get(ae.arg)
+                    if sv is None:
+                        raise CompilerError(f"agg input column {ae.arg!r} not found")
+                    if sv.dictionary is not None:
+                        raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
+                    vb = sv.build
+                    in_dtype = STORAGE_DTYPE[sv.dtype]
+                    in_types[ae.out_name] = sv.dtype
+                elif not uda.nullary:
+                    raise CompilerError(f"aggregate {ae.fn} requires an input column")
+                udas.append((ae.out_name, uda, vb))
+                init_specs.append((ae.out_name, uda, in_dtype))
+                state[ae.out_name] = uda.init(num_groups, in_dtype)
+            seen_uda = CountUDA()
+            udas.append((seen_name, seen_uda, None))
+            init_specs.append((seen_name, seen_uda, None))
+            state[seen_name] = seen_uda.init(num_groups)
+
+            step = kern.make_agg_step(keys, udas, num_groups)
+            partial_step = kern.make_partial_agg_step(keys, udas, num_groups, init_specs)
+            merge_fn = kern.make_merge_states(udas)
+            _cache_put(sig, (kern, keys, udas, in_types, init_specs, num_groups,
+                             seen_name, step, partial_step, merge_fn))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
         limit_total = _chain_limit(chain)
-        remaining = limit_total
-        has_limit = limit_total < INT64_MAX
-        intdict_keys = [k for k in keys if k.kind == "intdict"]
-        for cols, n_valid in self._feed(src, names, intdict_keys, cap):
-            state, cnt, consumed = step(
-                cols, np.int64(n_valid), t_lo, t_hi, np.int64(remaining), luts, state
-            )
-            # int(consumed) forces a device sync; only pay it when a limit is active.
-            if has_limit:
-                remaining -= int(consumed)
-                if remaining <= 0:
-                    break
+        if limit_total < INT64_MAX:
+            # Limit queries must thread the budget, so the feed steps chain;
+            # the budget stays a device scalar (no per-feed host sync).
+            remaining = jnp.asarray(limit_total, dtype=jnp.int64)
+            for cols, n_valid in self._feed(src, names, cap):
+                state, cnt, consumed = step(
+                    cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
+                )
+                remaining = remaining - consumed
+        else:
+            # No limit → per-feed partials are INDEPENDENT executions (init
+            # inside the trace), merged in one stacked reduction.  Dependent
+            # executions serialize badly on remote runtimes; this keeps the
+            # device pipeline flat: N parallel steps + 1 merge + 1 readback.
+            partials = [
+                partial_step(cols, np.int64(n_valid), t_lo, t_hi, luts)
+                for cols, n_valid in self._feed(src, names, cap)
+            ]
+            if len(partials) == 1:
+                state = partials[0]
+            elif partials:
+                state = merge_fn(*partials)
 
-        state_np = jax.tree.map(np.asarray, state)
+        state_np = transfer.pull(state)
         return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
 
     def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None) -> HostBatch:
@@ -577,7 +878,7 @@ class PlanExecutor:
                 if k.kind == "dict":
                     cols[k.name] = kc.astype(np.int32)
                     dicts[k.name] = k.dictionary
-                elif k.kind == "intdict":
+                elif k.kind == "intdevice":
                     vals = k.dictionary.decode(kc)
                     cols[k.name] = np.asarray(vals, dtype=STORAGE_DTYPE[k.out_dtype])
                 else:  # window
@@ -790,9 +1091,18 @@ def _source_time_range(src, head) -> tuple[int, int]:
     return t_min, max(t_min, t_max)
 
 
-def _prescan_unique(src, col: str, qd: Dictionary):
+def _prescan_unique(src, col: str, qd: Dictionary, sort: bool = False):
+    """Populate qd with the column's unique values; sort=True assigns codes in
+    sorted order (required by the intdevice searchsorted encoding)."""
     if isinstance(src, HostBatch):
-        qd.encode(src.cols[col])
+        vals = np.unique(src.cols[col]) if sort else src.cols[col]
+        qd.encode(vals)
+        return
+    if sort:
+        parts = [rb.columns[col][: rb.num_valid] for rb, _rid, _gen in src]
+        parts = [p for p in parts if len(p)]
+        if parts:
+            qd.encode(np.unique(np.concatenate([np.unique(p) for p in parts])))
         return
     for rb, _rid, _gen in src:
         arr = rb.columns[col][: rb.num_valid]

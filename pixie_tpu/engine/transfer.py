@@ -1,0 +1,29 @@
+"""Batched device→host readback.
+
+The readback analog of the reference's TransferResultChunk streaming
+(src/carnot/carnotpb/carnot.proto): all of a query's device outputs come back
+in ONE overlapped transfer wave.  Rationale: with a remote/tunneled TPU every
+synchronous `np.asarray(jax_array)` pays a fixed round-trip (~160 ms measured);
+issuing `copy_to_host_async` on every leaf first overlaps the round-trips, so N
+pulls cost ~1 RTT instead of N (measured: 10 pulls 1650 ms → 95 ms).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def pull(tree):
+    """Device pytree → host pytree of numpy arrays, round-trips overlapped.
+
+    Numpy leaves pass through unchanged.
+    """
+    leaves, treedef = jax.tree.flatten(tree)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            leaf.copy_to_host_async()
+    out = [
+        np.asarray(leaf) if isinstance(leaf, jax.Array) else leaf
+        for leaf in leaves
+    ]
+    return jax.tree.unflatten(treedef, out)

@@ -42,6 +42,9 @@ class LogHistogram:
         idx = jnp.where(v <= self.min_value, 0, idx)
         return jnp.clip(idx, 0, self.width - 1)
 
+    #: rows per chunk for the matmul path (one-hot bin buffer = chunk × width × 4B)
+    CHUNK = 1 << 13
+
     def update(
         self,
         hist: jax.Array,  # [num_groups, width]
@@ -50,8 +53,37 @@ class LogHistogram:
         mask: jax.Array,
         num_groups: int,
     ) -> jax.Array:
-        """Scatter-add values into per-group histograms via one flat segment_sum."""
-        flat_idx = gid.astype(jnp.int32) * self.width + self.bin_index(values)
+        """Add values into per-group histograms.
+
+        TPU path: hist += one_hot(gid).T @ one_hot(bin) per chunk — a pure MXU
+        GEMM [G,CH]@[CH,B] instead of a flat scatter-add (scatters serialize on
+        TPU; measured ~5x slower than the double-one-hot matmul at 16M rows).
+        """
+        n = gid.shape[0]
+        bins = self.bin_index(values)
+        ch = min(n, self.CHUNK)
+        if jax.default_backend() == "tpu" and num_groups <= 4096 and n >= 4096 and n % ch == 0:
+            g32 = gid.astype(jnp.int32)
+            m32 = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+            c = n // ch
+            if c == 1:
+                ohg = jax.nn.one_hot(g32, num_groups, dtype=jnp.float32) * m32[:, None]
+                ohb = jax.nn.one_hot(bins, self.width, dtype=jnp.float32)
+                return hist + (ohg.T @ ohb).astype(hist.dtype)
+
+            def body(carry, xs):
+                gg, bb, mm = xs
+                ohg = jax.nn.one_hot(gg, num_groups, dtype=jnp.float32) * mm[:, None]
+                ohb = jax.nn.one_hot(bb, self.width, dtype=jnp.float32)
+                return carry + (ohg.T @ ohb).astype(carry.dtype), None
+
+            add, _ = jax.lax.scan(
+                body,
+                jnp.zeros((num_groups, self.width), hist.dtype),
+                (g32.reshape(c, ch), bins.reshape(c, ch), m32.reshape(c, ch)),
+            )
+            return hist + add
+        flat_idx = gid.astype(jnp.int32) * self.width + bins
         ones = jnp.where(mask, 1.0, 0.0).astype(hist.dtype)
         add = jax.ops.segment_sum(ones, flat_idx, num_segments=num_groups * self.width)
         return hist + add.reshape(num_groups, self.width)

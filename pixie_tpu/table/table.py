@@ -21,6 +21,7 @@ hot/cold partitions (table.h:174-190, ABSL_GUARDED_BY annotations).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 from typing import Iterator
 
@@ -33,6 +34,10 @@ from pixie_tpu.types import STORAGE_DTYPE, DataType, Relation, is_dict_encoded
 
 DEFAULT_BATCH_ROWS = 1 << 16
 DEFAULT_TABLE_BYTES = 256 * 1024 * 1024
+
+#: Process-unique table ids for engine caches — id() of a freed Table can be
+#: reused by a new allocation, which would alias cache keys.
+_table_uid = itertools.count(1)
 
 
 class _SealedBatch:
@@ -63,6 +68,7 @@ class Table:
         batch_rows: int = DEFAULT_BATCH_ROWS,
     ):
         self.name = name
+        self.uid = next(_table_uid)
         self.relation = relation
         self.max_bytes = max_bytes
         self.batch_rows = batch_rows

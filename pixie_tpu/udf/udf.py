@@ -114,10 +114,9 @@ class CountUDA(UDA):
         return jnp.zeros((num_groups,), dtype=jnp.int64)
 
     def update(self, state, gid, value, mask, num_groups):
-        from pixie_tpu.ops.groupby import masked_segment_sum
+        from pixie_tpu.ops.groupby import masked_segment_count
 
-        ones = jnp.ones_like(gid, dtype=jnp.int64)
-        return state + masked_segment_sum(ones, gid, num_groups, mask)
+        return state + masked_segment_count(gid, num_groups, mask)
 
     def reduce_ops(self):
         return "add"
@@ -160,12 +159,11 @@ class MeanUDA(UDA):
         }
 
     def update(self, state, gid, value, mask, num_groups):
-        from pixie_tpu.ops.groupby import masked_segment_sum
+        from pixie_tpu.ops.groupby import masked_segment_count, masked_segment_sum
 
-        ones = jnp.ones_like(gid, dtype=jnp.int64)
         return {
             "sum": state["sum"] + masked_segment_sum(value.astype(jnp.float64), gid, num_groups, mask),
-            "count": state["count"] + masked_segment_sum(ones, gid, num_groups, mask),
+            "count": state["count"] + masked_segment_count(gid, num_groups, mask),
         }
 
     def reduce_ops(self):
@@ -243,14 +241,13 @@ class VarianceUDA(UDA):
         }
 
     def update(self, state, gid, value, mask, num_groups):
-        from pixie_tpu.ops.groupby import masked_segment_sum
+        from pixie_tpu.ops.groupby import masked_segment_count, masked_segment_sum
 
         v = value.astype(jnp.float64)
-        ones = jnp.ones_like(gid, dtype=jnp.int64)
         return {
             "sum": state["sum"] + masked_segment_sum(v, gid, num_groups, mask),
             "sumsq": state["sumsq"] + masked_segment_sum(v * v, gid, num_groups, mask),
-            "count": state["count"] + masked_segment_sum(ones, gid, num_groups, mask),
+            "count": state["count"] + masked_segment_count(gid, num_groups, mask),
         }
 
     def reduce_ops(self):

@@ -349,6 +349,39 @@ px.display(df)
     assert res["output"].relation.dtype("first") == DT.TIME64NS
 
 
+def test_nullary_count_after_projection(store):
+    # Regression: column pruning's keep-one fallback must register its input
+    # upstream (a nullary count requires no columns at all).
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df[['service']]
+df = df.agg(cnt=('service', px.count))
+px.display(df)
+"""
+    res, _ = run(store, src)
+    assert int(res["output"].to_pandas().cnt[0]) == N
+
+
+def test_column_reassignment_keeps_order(store):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df['time_', 'service', 'latency']
+df.service = px.to_upper(df.service)
+px.display(df)
+"""
+    res, _ = run(store, src)
+    assert res["output"].relation.names() == ["time_", "service", "latency"]
+
+
+def test_script_sandbox(store):
+    with pytest.raises(ImportError):
+        compile_pxl("import os\n", store.schemas(), now=NOW)
+    with pytest.raises(NameError):
+        compile_pxl("open('/etc/passwd')\n", store.schemas(), now=NOW)
+
+
 def test_errors(store):
     with pytest.raises(CompilerError):
         compile_pxl("import px\ndf = px.DataFrame(table='nope')\npx.display(df)",
