@@ -45,6 +45,8 @@ from pixie_tpu.plan.plan import (
     MemorySinkOp,
     MemorySourceOp,
     Plan,
+    RemoteSourceOp,
+    ResultSinkOp,
     UnionOp,
 )
 from pixie_tpu.status import CompilerError, Internal, Unimplemented
@@ -437,12 +439,15 @@ def _first_len(cols: dict) -> int:
 
 
 class PlanExecutor:
-    def __init__(self, plan: Plan, table_store, registry=None):
+    def __init__(self, plan: Plan, table_store, registry=None, inputs=None):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
         self.store = table_store
         self.registry = registry or default_registry
+        #: channel id → HostBatch injected by the cluster layer (remote edges;
+        #: reference: GRPCRouter demuxing inbound streams, grpc_router.h:52)
+        self.inputs: dict[str, HostBatch] = inputs or {}
         self._materialized: dict[int, HostBatch] = {}
         self.stats = {"rows_scanned": 0, "rows_output": 0, "batches": 0, "compile_s": 0.0}
 
@@ -566,6 +571,11 @@ class PlanExecutor:
             out = self._run_union(op)
         elif isinstance(op, MemorySourceOp):
             out = self._consume_to_batch(op, [])
+        elif isinstance(op, RemoteSourceOp):
+            got = self.inputs.get(op.channel)
+            if got is None:
+                raise Internal(f"no input injected for channel {op.channel!r}")
+            out = got
         else:
             raise Internal(f"unexpected blocking op {op.kind}")
         self._materialized[op.id] = out
@@ -764,6 +774,12 @@ class PlanExecutor:
         return keys
 
     def _run_agg(self, op: AggOp) -> HostBatch:
+        keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
+
+    def _agg_state(self, op: AggOp):
+        """Run the aggregation and pull the raw state (shared by the local
+        finalize path and the distributed partial path)."""
         head, chain = self._upstream_chain(self.plan.parents(op)[0])
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
 
@@ -858,7 +874,67 @@ class PlanExecutor:
                 state = merge_fn(*partials)
 
         state_np = transfer.pull(state)
-        return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
+        return keys, udas, state_np, seen_name, in_types
+
+    def _decode_key_column(self, k: GroupKey, codes: np.ndarray):
+        """Seen-group codes → (np column, dictionary|None) for key k."""
+        if k.kind == "dict":
+            return codes.astype(np.int32), k.dictionary
+        if k.kind == "intdevice":
+            vals = k.dictionary.decode(codes)
+            return np.asarray(vals, dtype=STORAGE_DTYPE[k.out_dtype]), None
+        return ((codes.astype(np.int64) + k.t0_bin) * k.width).astype(np.int64), None
+
+    def _partial_agg_batch(self, op: AggOp):
+        """Distributed partial path: seen groups as VALUES + raw UDA state
+        (see pixie_tpu.parallel.partial.PartialAggBatch)."""
+        from pixie_tpu.parallel.partial import PartialAggBatch
+
+        keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        seen_counts = np.asarray(state_np[seen_name])
+        if keys:
+            gids = np.nonzero(seen_counts > 0)[0]
+        else:
+            gids = np.array([0])
+        key_cols: dict = {}
+        key_dtypes: dict = {}
+        if keys:
+            from pixie_tpu.ops.groupby import split_codes
+
+            codes = split_codes(gids, [k.card for k in keys])
+            for k, kc in zip(keys, codes):
+                key_dtypes[k.name] = k.out_dtype
+                col, d = self._decode_key_column(k, kc)
+                if d is not None:
+                    # ship VALUES — each agent has a private code space
+                    key_cols[k.name] = np.asarray(d.decode(col), dtype=object)
+                else:
+                    key_cols[k.name] = col
+        states = {}
+        for out_name, _uda, _vb in udas:
+            if out_name == seen_name:
+                continue
+            states[out_name] = jax.tree.map(lambda x: np.asarray(x)[gids], state_np[out_name])
+        return PartialAggBatch(
+            key_cols=key_cols, key_dtypes=key_dtypes, states=states,
+            in_types={k: v for k, v in in_types.items()},
+        )
+
+    def run_agent(self) -> dict:
+        """Execute an AGENT plan: returns {channel: payload} where payload is a
+        HostBatch (rows channels) or PartialAggBatch (agg_state channels)."""
+        out = {}
+        for sink in self.plan.sinks():
+            if not isinstance(sink, ResultSinkOp):
+                raise Internal(f"agent plan sink {sink.kind} is not a ResultSink")
+            parent = self.plan.parents(sink)[0]
+            if sink.payload == "agg_state":
+                if not (isinstance(parent, AggOp) and parent.partial):
+                    raise Internal("agg_state channel must be fed by a partial AggOp")
+                out[sink.channel] = self._partial_agg_batch(parent)
+            else:
+                out[sink.channel] = self._materialize_parent(parent)
+        return out
 
     def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None) -> HostBatch:
         from pixie_tpu.ops.groupby import split_codes

@@ -1,8 +1,31 @@
 from pixie_tpu.parallel.spmd import (
     collective_merge,
+    collective_merge_carry,
     make_mesh,
     reduce_tree_for,
     spmd_agg_step,
 )
+from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
+from pixie_tpu.parallel.distributed import (
+    Channel,
+    DistributedPlan,
+    DistributedPlanner,
+)
+from pixie_tpu.parallel.partial import PartialAggBatch, merge_partials
+from pixie_tpu.parallel.cluster import LocalCluster
 
-__all__ = ["make_mesh", "collective_merge", "spmd_agg_step", "reduce_tree_for"]
+__all__ = [
+    "make_mesh",
+    "collective_merge",
+    "collective_merge_carry",
+    "spmd_agg_step",
+    "reduce_tree_for",
+    "AgentInfo",
+    "ClusterSpec",
+    "Channel",
+    "DistributedPlan",
+    "DistributedPlanner",
+    "PartialAggBatch",
+    "merge_partials",
+    "LocalCluster",
+]

@@ -1,0 +1,126 @@
+"""In-process distributed execution harness.
+
+Reference test strategy (SURVEY.md §4): every distributed behavior has an
+in-process seam — fake agent topologies for the planner, local loopback for
+shuffle edges.  LocalCluster is that seam made first-class: each agent has its
+own TableStore (its own dictionary code spaces, like independent PEMs), the
+planner splits queries across them, agents run their fragments, and channel
+payloads are merged exactly as a remote merger would — including a real
+serialization round-trip so the wire format is exercised on every query.
+
+The same execute() contract is what the networked query broker (services
+milestone) drives over real transport.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from pixie_tpu.engine.executor import HostBatch, PlanExecutor
+from pixie_tpu.engine.result import QueryResult
+from pixie_tpu.parallel.distributed import DistributedPlanner
+from pixie_tpu.parallel.partial import PartialAggBatch, merge_partials
+from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.status import Internal
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.table.table import TableStore
+import numpy as np
+
+
+def _union_host_batches(batches: list[HostBatch]) -> HostBatch:
+    """Concatenate row batches from different agents, reconciling each
+    dictionary code space into a fresh merged dictionary."""
+    batches = [b for b in batches if b.num_rows > 0] or batches[:1]
+    first = batches[0]
+    from pixie_tpu.engine.eval import apply_lut_np
+
+    cols, dicts = {}, {}
+    for name, dt in first.dtypes.items():
+        if name in first.dicts:
+            target = Dictionary()
+            dicts[name] = target
+            parts = []
+            for b in batches:
+                lut = b.dicts[name].translate_to(target, insert=True)
+                parts.append(apply_lut_np(lut, b.cols[name]))
+            cols[name] = np.concatenate(parts)
+        else:
+            cols[name] = np.concatenate([b.cols[name] for b in batches])
+    return HostBatch(dict(first.dtypes), dicts, cols)
+
+
+class LocalCluster:
+    """N agents with private table stores + one merger, in one process."""
+
+    def __init__(self, stores: dict, merger_store: Optional[TableStore] = None,
+                 registry=None, n_devices_per_agent: int = 1):
+        self.stores = dict(stores)
+        self.merger_store = merger_store or TableStore()
+        self.registry = registry
+        agents = [
+            AgentInfo(
+                name=name,
+                has_data_store=True,
+                processes_data=True,
+                accepts_remote_sources=False,
+                schemas=store.schemas(),
+                n_devices=n_devices_per_agent,
+            )
+            for name, store in self.stores.items()
+        ]
+        agents.append(
+            AgentInfo(
+                name="merger",
+                has_data_store=False,
+                processes_data=False,
+                accepts_remote_sources=True,
+                schemas={},
+            )
+        )
+        self.spec = ClusterSpec(agents)
+        self.planner = DistributedPlanner(self.spec)
+
+    def schemas(self) -> dict:
+        return self.spec.combined_schemas()
+
+    def query(self, pxl_source: str, func: Optional[str] = None,
+              func_args: Optional[dict] = None, now: Optional[int] = None,
+              default_limit: Optional[int] = None) -> dict[str, QueryResult]:
+        """Compile a PxL script against the cluster's combined schemas and
+        execute it distributed (the ExecuteScript analog)."""
+        from pixie_tpu.compiler import compile_pxl
+
+        q = compile_pxl(pxl_source, self.schemas(), func=func, func_args=func_args,
+                        now=now, default_limit=default_limit)
+        return self.execute(q.plan)
+
+    def execute(self, logical: Plan) -> dict[str, QueryResult]:
+        dp = self.planner.plan(logical)
+
+        # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan).
+        payloads: dict[str, list] = {cid: [] for cid in dp.channels}
+        for agent_name, plan in dp.agent_plans.items():
+            ex = PlanExecutor(plan, self.stores[agent_name], self.registry)
+            for cid, payload in ex.run_agent().items():
+                if isinstance(payload, PartialAggBatch):
+                    # round-trip the wire format on every query
+                    payload = PartialAggBatch.from_bytes(payload.to_bytes())
+                payloads[cid].append(payload)
+
+        # 2. merge channel payloads (reference: Kelvin finalize / row merge).
+        inputs: dict[str, HostBatch] = {}
+        reg = self.registry
+        if reg is None:
+            from pixie_tpu.udf import registry as reg
+        for cid, ch in dp.channels.items():
+            got = payloads.get(cid, [])
+            if not got:
+                raise Internal(f"channel {cid} received no payloads")
+            if ch.kind == "agg_state":
+                inputs[cid] = merge_partials(ch.agg, got, reg)
+            else:
+                inputs[cid] = _union_host_batches(got)
+
+        # 3. run the merger plan over the injected channels.
+        ex = PlanExecutor(dp.merger_plan, self.merger_store, self.registry, inputs=inputs)
+        return ex.run()

@@ -1,0 +1,203 @@
+"""Distributed planner: logical plan → per-agent plans + channels.
+
+Reference architecture (src/carnot/planner/distributed/): Coordinator partitions
+by CarnotInfo, Splitter cuts the plan at blocking operators inserting
+GRPCSink/GRPCSourceGroup pairs (splitter/splitter.h:114-155), and
+PartialOperatorMgr splits aggregates into partial (data agents) + finalize
+(merger) (splitter/partial_op_mgr/).  This implementation mirrors those
+boundaries with a TPU-shaped data plane:
+
+  * source-side fragments (scan → map/filter/limit → [partial agg]) run on
+    every data agent holding the table, SPMD over the agent's local mesh;
+  * a "rows" channel ships compacted row batches; an "agg_state" channel ships
+    value-keyed per-group UDA state (each agent has its OWN dictionary code
+    space, so group keys cross agents as VALUES — the analog of the reference's
+    serialized-UDA partial rows);
+  * the merger re-aggregates the shipped state (pixie_tpu.parallel.partial) and
+    runs everything downstream of the cut.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Optional
+
+from pixie_tpu.plan.plan import (
+    AggOp,
+    FilterOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    RemoteSourceOp,
+    ResultSinkOp,
+)
+from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
+from pixie_tpu.status import CompilerError
+
+_STREAMABLE = (MapOp, FilterOp, LimitOp)
+
+
+@dataclasses.dataclass
+class Channel:
+    """One remote edge (reference: a GRPCSink/GRPCSourceGroup pair keyed by
+    (query_id, source_id); here a named channel)."""
+
+    id: str
+    kind: str  # "rows" | "agg_state"
+    #: producing agents
+    producers: list = dataclasses.field(default_factory=list)
+    #: for agg_state channels: the full AggOp spec merged at the consumer
+    agg: Optional[AggOp] = None
+
+
+@dataclasses.dataclass
+class DistributedPlan:
+    """Per-agent plans + the merger plan + channel specs."""
+
+    agent_plans: dict  # agent name -> Plan
+    merger_plan: Plan
+    channels: dict  # channel id -> Channel
+    merger: str
+
+    def to_dict(self) -> dict:
+        return {
+            "agents": {n: p.to_dict() for n, p in self.agent_plans.items()},
+            "merger": self.merger,
+            "merger_plan": self.merger_plan.to_dict(),
+            "channels": {
+                c.id: {
+                    "kind": c.kind,
+                    "producers": list(c.producers),
+                    "agg": c.agg.to_dict() if c.agg else None,
+                }
+                for c in self.channels.values()
+            },
+        }
+
+
+class DistributedPlanner:
+    """Splits one logical plan across a ClusterSpec (reference
+    DistributedPlanner::Plan, distributed_planner.cc)."""
+
+    def __init__(self, cluster: ClusterSpec):
+        self.cluster = cluster
+
+    def plan(self, logical: Plan) -> DistributedPlan:
+        merger = self.cluster.merger()
+        chan_ids = itertools.count(0)
+        channels: dict[str, Channel] = {}
+        # per data agent: list of (ops to add); built as op-chains
+        agent_frags: dict[str, list[list]] = {a.name: [] for a in self.cluster.agents}
+        merger_plan = Plan()
+        #: logical op id -> merger plan op (for downstream reconstruction)
+        lowered: dict[int, object] = {}
+
+        def lower_downstream(op):
+            """Copy a logical op into the merger plan (parents must already be
+            lowered)."""
+            import copy
+
+            parents = [lowered[p.id] for p in logical.parents(op)]
+            c = copy.copy(op)
+            c.id = -1
+            merger_plan.add(c, parents=parents)
+            lowered[op.id] = c
+            return c
+
+        # Walk sources: carve off the source-side fragment for each.
+        for src in logical.sources():
+            if not isinstance(src, MemorySourceOp):
+                raise CompilerError(f"distributed plan source must be a table scan, got {src.kind}")
+            producers = [a for a in self.cluster.data_agents(src.table)]
+            if not producers:
+                raise CompilerError(f"no agent has table {src.table!r}")
+
+            chain = [src]
+            cur = src
+            while True:
+                children = logical.children(cur)
+                if len(children) != 1:
+                    break
+                nxt = children[0]
+                if isinstance(nxt, _STREAMABLE) and len(logical.parents(nxt)) == 1:
+                    chain.append(nxt)
+                    cur = nxt
+                    continue
+                break
+            children = logical.children(cur)
+            cut_agg = None
+            if (
+                len(children) == 1
+                and isinstance(children[0], AggOp)
+                and len(logical.parents(children[0])) == 1
+            ):
+                cut_agg = children[0]
+
+            cid = f"ch{next(chan_ids)}"
+            if cut_agg is not None:
+                # partial agg on agents; value-keyed state over the channel;
+                # merger re-aggregates (the finalize side).
+                import copy
+
+                partial = copy.copy(cut_agg)
+                partial.id = -1
+                partial.partial = True
+                frag = [*chain, partial, ResultSinkOp(channel=cid, payload="agg_state")]
+                ch = Channel(cid, "agg_state", [a.name for a in producers],
+                             agg=copy.copy(cut_agg))
+                channels[cid] = ch
+                for a in producers:
+                    agent_frags[a.name].append(frag)
+                # merger side: the merged+finalized agg arrives as rows.
+                rs = RemoteSourceOp(channel=cid)
+                merger_plan.add(rs)
+                lowered[cut_agg.id] = rs
+                self._lower_rest(logical, cut_agg, lowered, lower_downstream)
+            else:
+                frag = [*chain, ResultSinkOp(channel=cid, payload="rows")]
+                channels[cid] = Channel(cid, "rows", [a.name for a in producers])
+                for a in producers:
+                    agent_frags[a.name].append(frag)
+                rs = RemoteSourceOp(channel=cid)
+                merger_plan.add(rs)
+                lowered[cur.id] = rs
+                self._lower_rest(logical, cur, lowered, lower_downstream)
+
+        # Materialize agent plans.
+        agent_plans: dict[str, Plan] = {}
+        for a in self.cluster.agents:
+            frags = agent_frags.get(a.name) or []
+            if not frags:
+                continue
+            p = Plan()
+            import copy
+
+            for frag in frags:
+                prev = None
+                for op in frag:
+                    c = copy.copy(op)
+                    c.id = -1
+                    p.add(c, parents=[prev] if prev is not None else [])
+                    prev = c
+            agent_plans[a.name] = p
+
+        return DistributedPlan(
+            agent_plans=agent_plans,
+            merger_plan=merger_plan,
+            channels=channels,
+            merger=merger.name,
+        )
+
+    def _lower_rest(self, logical: Plan, boundary, lowered: dict, lower_downstream):
+        """Lower everything strictly downstream of `boundary` into the merger
+        plan, in topological order, once all of an op's parents are lowered."""
+        for op in logical.topo_sorted():
+            if op.id in lowered:
+                continue
+            parents = logical.parents(op)
+            if not parents:
+                continue  # another source; handled by its own fragment walk
+            if all(p.id in lowered for p in parents):
+                lower_downstream(op)

@@ -1,0 +1,199 @@
+"""Value-keyed partial aggregate transport + merge.
+
+Reference: the splitter rewrites Agg into partial_agg (PEM) whose output rows
+carry serialized UDA state strings, merged by finalize_results on Kelvin
+(planpb/plan.proto:250-257, udf/udf.h:326-368 Serialize/Deserialize).
+
+TPU build: UDA state is a pytree of dense arrays, so "serialization" is just
+numpy — a PartialAggBatch holds the seen groups' key VALUES (decoded out of the
+producing agent's private dictionary space) plus each UDA's state leaves sliced
+to those groups.  Merging re-groups by key values and reduces each leaf with
+the UDA's declared reduce op — no per-UDA merge code, and the same reduce tree
+drives the in-mesh psum path (pixie_tpu.parallel.spmd).
+"""
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+
+import numpy as np
+
+from pixie_tpu.engine.executor import HostBatch
+from pixie_tpu.plan.plan import AggOp
+from pixie_tpu.status import InvalidArgument
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import STORAGE_DTYPE, DataType as DT
+
+
+@dataclasses.dataclass
+class PartialAggBatch:
+    """Seen-group key values + per-UDA state leaves for one producer."""
+
+    #: group key name -> np array of VALUES (object array for strings/UPIDs)
+    key_cols: dict
+    #: group key name -> DataType
+    key_dtypes: dict
+    #: uda out_name -> pytree of np arrays, leading dim = num seen groups
+    states: dict
+    #: uda out_name -> input DataType (None for nullary)
+    in_types: dict
+
+    @property
+    def num_groups(self) -> int:
+        for v in self.key_cols.values():
+            return len(v)
+        for tree in self.states.values():
+            leaves = _leaves(tree)
+            return len(leaves[0]) if leaves else 0
+        return 0
+
+    # Wire format (the TransferResultChunk analog for state channels): a
+    # restricted pickle of plain numpy/str/int structures.
+    def to_bytes(self) -> bytes:
+        buf = io.BytesIO()
+        pickle.dump(
+            {
+                "key_cols": self.key_cols,
+                "key_dtypes": {k: int(v) for k, v in self.key_dtypes.items()},
+                "states": self.states,
+                "in_types": {k: (int(v) if v is not None else None) for k, v in self.in_types.items()},
+            },
+            buf,
+            protocol=4,
+        )
+        return buf.getvalue()
+
+    @staticmethod
+    def from_bytes(b: bytes) -> "PartialAggBatch":
+        d = pickle.loads(b)
+        return PartialAggBatch(
+            key_cols=d["key_cols"],
+            key_dtypes={k: DT(v) for k, v in d["key_dtypes"].items()},
+            states=d["states"],
+            in_types={k: (DT(v) if v is not None else None) for k, v in d["in_types"].items()},
+        )
+
+
+def _leaves(tree):
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            out.extend(_leaves(tree[k]))
+        return out
+    return [tree]
+
+
+def _tree_map2(fn, ops_tree, state_tree):
+    if isinstance(ops_tree, dict):
+        return {k: _tree_map2(fn, ops_tree[k], state_tree[k]) for k in ops_tree}
+    return fn(ops_tree, state_tree)
+
+
+_NP_REDUCE = {
+    "add": np.add,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+
+
+def merge_partials(
+    agg: AggOp, partials: list[PartialAggBatch], registry
+) -> HostBatch:
+    """Merge value-keyed partials from N producers and finalize → HostBatch.
+
+    The merge itself is a host-side segment reduction over the concatenated
+    group rows — states are tiny (seen groups only), so this stays off-device;
+    the heavy per-row work already happened on each producer's mesh.
+    """
+    parts = [p for p in partials if p.num_groups > 0]
+    if not parts:
+        parts = [p for p in partials[:1]]
+    if not parts:
+        raise InvalidArgument("merge_partials: no partial batches")
+    first = parts[0]
+    keys = list(first.key_cols)
+
+    # Composite group identity across producers (VALUES, not codes).
+    if keys:
+        cols_cat = {
+            k: np.concatenate([np.asarray(p.key_cols[k], dtype=object) if first.key_dtypes[k] in (DT.STRING, DT.UINT128) else np.asarray(p.key_cols[k]) for p in parts])
+            for k in keys
+        }
+        if len(keys) == 1:
+            comp = cols_cat[keys[0]]
+        else:
+            comp = np.array(list(zip(*[cols_cat[k] for k in keys])), dtype=object)
+            comp = np.fromiter((tuple(r) for r in comp), dtype=object, count=len(comp))
+        uniq, inverse = np.unique(comp, return_inverse=True)
+        g = len(uniq)
+        first_idx = np.full(g, -1, np.int64)
+        first_idx[inverse[::-1]] = np.arange(len(inverse))[::-1]
+    else:
+        total = sum(p.num_groups for p in parts)
+        inverse = np.zeros(total, np.int64)
+        g = 1
+        first_idx = np.zeros(1, np.int64)
+
+    out_cols: dict[str, np.ndarray] = {}
+    out_dtypes: dict[str, DT] = {}
+    out_dicts: dict[str, Dictionary] = {}
+    for k in keys:
+        dt = first.key_dtypes[k]
+        vals = cols_cat[k][first_idx]
+        out_dtypes[k] = dt
+        if dt in (DT.STRING, DT.UINT128):
+            d = Dictionary()
+            out_cols[k] = d.encode(vals.tolist())
+            out_dicts[k] = d
+        else:
+            out_cols[k] = np.asarray(vals.tolist(), dtype=STORAGE_DTYPE[dt])
+
+    for ae in agg.values:
+        uda = registry.uda(ae.fn)
+        ops_tree = uda.reduce_ops()
+        # Concatenate each leaf across producers, then segment-reduce by the
+        # merged group id.
+        def merge_leaf(op, leaf_list):
+            cat = np.concatenate(leaf_list, axis=0)
+            shape = (g,) + cat.shape[1:]
+            if op == "add":
+                out = np.zeros(shape, dtype=cat.dtype)
+                np.add.at(out, inverse, cat)
+            elif op == "min":
+                out = np.full(shape, _np_identity(cat.dtype, "min"))
+                np.minimum.at(out, inverse, cat)
+            else:
+                out = np.full(shape, _np_identity(cat.dtype, "max"))
+                np.maximum.at(out, inverse, cat)
+            return out
+
+        def walk(ops_t, trees):
+            if isinstance(ops_t, dict):
+                return {k: walk(ops_t[k], [t[k] for t in trees]) for k in ops_t}
+            return merge_leaf(ops_t, trees)
+
+        merged_state = walk(ops_tree, [p.states[ae.out_name] for p in parts])
+        # Re-init instance state for finalize (QuantileUDA binds its sketch in init).
+        uda.init(g, np.float64)
+        col = uda.finalize_host(merged_state)
+        in_t = first.in_types.get(ae.out_name)
+        out_dt = uda.out_type(in_t)
+        vals = np.asarray(col)
+        out_dtypes[ae.out_name] = out_dt
+        if out_dt == DT.STRING:
+            d = Dictionary()
+            out_cols[ae.out_name] = d.encode(vals.tolist())
+            out_dicts[ae.out_name] = d
+        else:
+            out_cols[ae.out_name] = vals.astype(STORAGE_DTYPE[out_dt], copy=False)
+
+    return HostBatch(out_dtypes, out_dicts, out_cols)
+
+
+def _np_identity(dtype, op: str):
+    d = np.dtype(dtype)
+    if d.kind == "f":
+        return np.inf if op == "min" else -np.inf
+    info = np.iinfo(d)
+    return info.max if op == "min" else info.min

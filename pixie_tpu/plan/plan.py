@@ -225,6 +225,37 @@ class UnionOp(Operator):
         return {}
 
 
+@dataclasses.dataclass
+class ResultSinkOp(Operator):
+    """Terminal op on an agent plan shipping results to a remote consumer
+    (reference exec/grpc_sink_node.* streaming TransferResultChunk).
+
+    payload "rows": parent's row batches ship as-is.
+    payload "agg_state": parent is AggOp(partial=True); the per-group UDA state
+    ships value-keyed (group VALUES + state leaves), the TPU analog of the
+    reference's serialized-UDA-string partial rows (planpb plan.proto:250-257).
+    """
+
+    channel: str = ""
+    payload: str = "rows"
+
+    def _fields(self):
+        return {"channel": self.channel, "payload": self.payload}
+
+
+@dataclasses.dataclass
+class RemoteSourceOp(Operator):
+    """Source on a merger plan reading a channel fed by remote agents
+    (reference exec/grpc_source_node.* + grpc_router.h demux)."""
+
+    channel: str = ""
+    #: relation of the incoming rows (serialized schema)
+    schema: Optional[list] = None
+
+    def _fields(self):
+        return {"channel": self.channel, "schema": self.schema}
+
+
 # ------------------------------------------------------------------------ plan
 
 
@@ -343,4 +374,8 @@ def _op_from_dict(d: dict):
         )
     if k == "union":
         return UnionOp()
+    if k == "resultsink":
+        return ResultSinkOp(channel=d["channel"], payload=d["payload"])
+    if k == "remotesource":
+        return RemoteSourceOp(channel=d["channel"], schema=d["schema"])
     raise InvalidArgument(f"unknown operator kind {k!r}")
