@@ -11,6 +11,7 @@ Scripts come in two shapes (mirroring the bundled pxl_scripts):
 """
 from __future__ import annotations
 
+import ast
 import dataclasses
 import threading
 from typing import Optional
@@ -30,9 +31,13 @@ _exec_lock = threading.Lock()
 #: front end for the same reason).  This is defense-in-depth, not a sandbox:
 #: no file/process/import machinery, just the pure helpers scripts reasonably
 #: use.  `__import__` is allowed solely for `import px`.
+#: `format` (builtin and str method) is excluded: its replacement-field
+#: mini-language performs attribute traversal from string constants
+#: ("{0.__class__}"), bypassing the AST-level dunder rules.  f-strings remain
+#: available — their expressions are real AST nodes and get validated.
 _SAFE_BUILTIN_NAMES = [
     "abs", "all", "any", "bool", "dict", "divmod", "enumerate", "filter",
-    "float", "format", "frozenset", "hash", "int", "isinstance", "issubclass",
+    "float", "frozenset", "hash", "int", "isinstance", "issubclass",
     "iter", "len", "list", "map", "max", "min", "next", "print", "range",
     "repr", "reversed", "round", "set", "slice", "sorted", "str", "sum",
     "tuple", "zip", "True", "False", "None", "ValueError", "TypeError",
@@ -52,8 +57,65 @@ def _safe_builtins(px_module) -> dict:
 
     out = {n: getattr(_b, n) for n in _SAFE_BUILTIN_NAMES if hasattr(_b, n)}
     out["__import__"] = _import
-    out["__build_class__"] = _b.__build_class__
     return out
+
+
+#: AST node types a PxL script may contain.  PxL is a dataframe-building
+#: dialect: expressions, assignments, function defs (typed script entry
+#: points), conditionals, loops over literals, and comprehensions.  Everything
+#: that reaches host machinery — while/with/try, class bodies, async, del,
+#: global/nonlocal — is rejected up front, and any identifier or attribute
+#: starting with "_" (the attribute-traversal escape hatch:
+#: ().__class__.__base__...) fails validation before exec ever runs.
+_ALLOWED_PXL_NODES = frozenset(
+    n
+    for n in (
+        "Module", "Expr", "Assign", "AugAssign", "AnnAssign", "FunctionDef",
+        "Return", "Import", "alias", "If", "For", "Break", "Continue", "Pass",
+        "arguments", "arg", "keyword", "Lambda", "Call", "Attribute",
+        "Subscript", "Slice", "Starred", "Name",
+        "Constant", "IfExp", "BinOp", "BoolOp",
+        "UnaryOp", "Compare", "List", "Tuple", "Dict", "Set", "JoinedStr",
+        "FormattedValue", "ListComp", "DictComp", "SetComp", "GeneratorExp",
+        "comprehension", "Load", "Store", "Del", "And", "Or", "Not", "Add",
+        "Sub", "Mult", "Div", "FloorDiv", "Mod", "Pow", "LShift", "RShift",
+        "BitOr", "BitXor", "BitAnd", "MatMult", "UAdd", "USub", "Invert",
+        "Eq", "NotEq", "Lt", "LtE", "Gt", "GtE", "Is", "IsNot", "In", "NotIn",
+        "Assert", "Raise", "expr_context", "withitem", "TypeIgnore",
+    )
+    if hasattr(ast, n)
+)
+
+
+def validate_pxl_source(source: str) -> ast.Module:
+    """Parse + validate untrusted PxL text; raises CompilerError on anything
+    outside the dialect.  The reference parses PxL in its own front end
+    (planner/parser/parser.cc) precisely so query text never executes as host
+    code; this whitelist is our equivalent gate."""
+    try:
+        tree = ast.parse(source, "<pxl>")
+    except SyntaxError as e:
+        raise CompilerError(f"PxL syntax error: {e}") from None
+    for node in ast.walk(tree):
+        name = type(node).__name__
+        if name not in _ALLOWED_PXL_NODES:
+            raise CompilerError(f"PxL does not allow {name} statements")
+        if isinstance(node, ast.Attribute) and (
+            node.attr.startswith("_") or node.attr in ("format", "format_map")
+        ):
+            raise CompilerError(
+                f"PxL does not allow access to attribute {node.attr!r}"
+            )
+        if isinstance(node, ast.Name) and node.id.startswith("_"):
+            raise CompilerError(
+                f"PxL does not allow underscored identifier {node.id!r}"
+            )
+        if isinstance(node, ast.FunctionDef):
+            if node.decorator_list:
+                raise CompilerError("PxL does not allow decorators")
+        if isinstance(node, ast.alias) and node.name != "px":
+            raise CompilerError("PxL scripts may only `import px`")
+    return tree
 
 
 @dataclasses.dataclass
@@ -97,7 +159,8 @@ def compile_pxl(
     # dont_inherit: this module uses `from __future__ import annotations`, which
     # compile() would otherwise leak into the script, stringifying the typed
     # function parameters we coerce below.
-    code = compile(source, "<pxl>", "exec", dont_inherit=True)
+    tree = validate_pxl_source(source)
+    code = compile(tree, "<pxl>", "exec", dont_inherit=True)
     # `import px` resolves through the restricted __import__ hook to THIS
     # compilation's module instance — no sys.modules juggling needed.
     exec(code, glb)

@@ -68,7 +68,7 @@ def resolve_time(value, now: int) -> int:
     if isinstance(value, float):
         return int(value)
     if isinstance(value, datetime.datetime):
-        return int(_as_utc(value).timestamp() * SECOND)
+        return _datetime_ns(value)
     if isinstance(value, str):
         # Relative durations resolve against now; absolute ISO strings parse.
         try:
@@ -79,8 +79,19 @@ def resolve_time(value, now: int) -> int:
             dt = datetime.datetime.fromisoformat(value)
         except ValueError:
             raise ValueError(f"cannot parse time {value!r}") from None
-        return int(_as_utc(dt).timestamp() * SECOND)
+        return _datetime_ns(dt)
     raise ValueError(f"cannot parse time {value!r}")
+
+
+_EPOCH = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+def _datetime_ns(dt: datetime.datetime) -> int:
+    """Exact ns since epoch.  float timestamp() has only ~us precision at
+    current epochs, which nondeterministically shifts boundary rows; timedelta
+    arithmetic is exact at datetime's native microsecond resolution."""
+    delta = _as_utc(dt) - _EPOCH
+    return (delta.days * 86400 + delta.seconds) * SECOND + delta.microseconds * 1000
 
 
 def _as_utc(dt: datetime.datetime) -> datetime.datetime:

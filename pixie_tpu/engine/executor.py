@@ -105,6 +105,30 @@ def _op_sig(op) -> dict:
     return d
 
 
+def _iter_call_fns(expr):
+    """Yield every Call fn name in an expression tree."""
+    if isinstance(expr, Call):
+        yield expr.fn
+        for a in expr.args:
+            yield from _iter_call_fns(a)
+
+
+def _chain_uses_volatile(chain, registry) -> bool:
+    """True if any chain expression calls a volatile (metadata-reading) UDF —
+    such kernels bake snapshot-derived LUTs and must cache per state epoch."""
+    for op in chain:
+        exprs = []
+        if isinstance(op, MapOp):
+            exprs = [e for _n, e in op.exprs]
+        elif isinstance(op, FilterOp):
+            exprs = [op.expr]
+        for e in exprs:
+            for fn in _iter_call_fns(e):
+                if registry.is_volatile(fn):
+                    return True
+    return False
+
+
 # ------------------------------------------------------------ device feed cache
 # The TPU-native analog of the reference's cold store (table/table.h hot/cold
 # partitions): sealed batches are immutable, so their assembled, padded device
@@ -258,18 +282,30 @@ class ChainKernel:
         self.ctx = _ChainCtx(in_dtypes, in_dicts, registry, visible)
         self.registry = registry
         self.time_col = time_col
-        self.steps = []  # ("map", op) applied symbolically; ("filter", sval); ("limit",)
-        self.has_limit = False
+        self.steps = []  # ("map", op) applied symbolically; ("filter", sval); ("limit", i)
+        #: per-LimitOp budgets, in chain order — each limit step tracks its OWN
+        #: remaining budget (a single min-collapsed budget under-returns when a
+        #: filter between two limits drops admitted rows).
+        self.limit_ns: list[int] = []
         for op in transforms:
             if isinstance(op, MapOp):
                 self.ctx.apply_map(op)
             elif isinstance(op, FilterOp):
                 self.steps.append(("filter", self.ctx.compile_predicate(op)))
             elif isinstance(op, LimitOp):
-                self.steps.append(("limit", None))
-                self.has_limit = True
+                self.steps.append(("limit", len(self.limit_ns)))
+                self.limit_ns.append(int(op.n))
             else:
                 raise Internal(f"non-streamable op {op.kind} in chain")
+
+    @property
+    def has_limit(self) -> bool:
+        return bool(self.limit_ns)
+
+    def init_limits(self) -> jnp.ndarray:
+        """Initial per-limit remaining budgets (shape [max(1, n_limits)])."""
+        ns = self.limit_ns or [INT64_MAX]
+        return jnp.asarray(np.asarray(ns, dtype=np.int64))
 
     @property
     def luts(self) -> dict[str, np.ndarray]:
@@ -282,27 +318,29 @@ class ChainKernel:
             mask = mask & (t >= t_lo) & (t < t_hi)
         return mask
 
-    def _apply_steps(self, env, mask, limit_remaining):
-        """Apply filter/limit steps. Returns (mask, limit_consumed).
+    def _apply_steps(self, env, mask, limits):
+        """Apply filter/limit steps. Returns (mask, consumed[n_limits]).
 
-        limit_consumed counts the limit slots used by THIS batch — rows reaching
-        the (first) limit step, capped at the remaining budget.  It is what the
-        host must subtract from `remaining`: decrementing by the final output
-        count instead would let later batches emit rows past the limit whenever
-        a downstream filter drops limit-admitted rows.
+        `limits` is the per-limit remaining-budget vector (shape
+        [max(1, n_limits)]).  consumed[i] counts limit i's slots used by THIS
+        batch — rows reaching that limit step, capped at its remaining budget.
+        The host subtracts the whole vector from `remaining`: decrementing by
+        the final output count instead would let later batches emit rows past
+        a limit whenever a downstream filter drops admitted rows.
         """
-        consumed = jnp.int64(0)
-        seen_limit = False
+        consumed = [jnp.int64(0)] * max(1, len(self.limit_ns))
         for kind, sv in self.steps:
             if kind == "filter":
                 mask = mask & sv.build(env)
-            else:  # limit
+            else:  # limit; sv = budget index
+                # Scalar `limits` broadcasts one shared budget (SPMD callers
+                # pass INT64_MAX); the executor always passes the per-limit
+                # vector from init_limits().
+                rem = limits[sv] if jnp.ndim(limits) else limits
                 reaching = jnp.sum(mask.astype(jnp.int64))
-                mask = mask & (jnp.cumsum(mask.astype(jnp.int64)) <= limit_remaining)
-                if not seen_limit:
-                    consumed = jnp.minimum(reaching, limit_remaining)
-                    seen_limit = True
-        return mask, consumed
+                mask = mask & (jnp.cumsum(mask.astype(jnp.int64)) <= rem)
+                consumed[sv] = jnp.minimum(reaching, rem)
+        return mask, jnp.stack(consumed)
 
     def make_output_step(self, out_names: list[str]):
         """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts)
@@ -344,10 +382,13 @@ class ChainKernel:
         raw = self.make_agg_step(keys, udas, num_groups, jit=False)
         spec = list(init_specs)
 
+        n_lim = max(1, len(self.limit_ns))
+
         def step(cols, n_valid, t_lo, t_hi, luts):
             state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in spec}
             new_state, _cnt, _consumed = raw(
-                cols, n_valid, t_lo, t_hi, jnp.int64(INT64_MAX), luts, state
+                cols, n_valid, t_lo, t_hi,
+                jnp.full((n_lim,), INT64_MAX, dtype=jnp.int64), luts, state
             )
             return new_state
 
@@ -609,6 +650,13 @@ class PlanExecutor:
             "dicts": {n: (id(d), d.size) for n, d in dicts.items()},
             "extra": extra,
         }
+        if _chain_uses_volatile(chain, self.registry):
+            # Metadata UDFs bake the K8sSnapshot into LUTs at kernel-build
+            # time; a new epoch must miss the cache even when no dictionary
+            # grew (e.g. a pod rename reuses every existing string).
+            from pixie_tpu.metadata import state as _mdstate
+
+            key["md_epoch"] = _mdstate.global_manager().epoch
         return _json.dumps(key, sort_keys=True, default=str)
 
     def _consume_chain(self, terminal_parent, out_names=None):
@@ -651,16 +699,15 @@ class PlanExecutor:
             _cache_put(sig, (kern, step, out_dtypes, out_dicts, out_names))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
-        limit_total = _chain_limit(chain)
 
         def gen():
             # Fully async pipeline: dispatch every feed's step with the limit
-            # budget carried as a DEVICE scalar (no per-feed host sync), then
+            # budgets carried as a DEVICE vector (no per-feed host sync), then
             # exactly two round-trips — one packed pull of the row counts, one
             # packed pull of the count-sliced outputs.  With a remote TPU each
             # readback costs a fixed RTT, so per-feed pulls would dominate.
-            has_limit = limit_total < INT64_MAX
-            remaining = jnp.asarray(limit_total, dtype=jnp.int64)
+            has_limit = kern.has_limit
+            remaining = kern.init_limits()
             feeds = []
             for cols, n_valid in self._feed(src, names, cap):
                 outs, cnt, consumed = step(
@@ -849,11 +896,10 @@ class PlanExecutor:
                              seen_name, step, partial_step, merge_fn))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
-        limit_total = _chain_limit(chain)
-        if limit_total < INT64_MAX:
-            # Limit queries must thread the budget, so the feed steps chain;
-            # the budget stays a device scalar (no per-feed host sync).
-            remaining = jnp.asarray(limit_total, dtype=jnp.int64)
+        if kern.has_limit:
+            # Limit queries must thread the budgets, so the feed steps chain;
+            # the budgets stay a device vector (no per-feed host sync).
+            remaining = kern.init_limits()
             for cols, n_valid in self._feed(src, names, cap):
                 state, cnt, consumed = step(
                     cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
@@ -1133,14 +1179,6 @@ def _time_bounds(head) -> tuple[np.int64, np.int64]:
         hi = INT64_MAX if head.stop_time is None else int(head.stop_time)
         return np.int64(lo), np.int64(hi)
     return np.int64(INT64_MIN), np.int64(INT64_MAX)
-
-
-def _chain_limit(chain) -> int:
-    lim = INT64_MAX
-    for op in chain:
-        if isinstance(op, LimitOp):
-            lim = min(lim, int(op.n))
-    return lim
 
 
 def _window_key(expr) -> Optional[int]:

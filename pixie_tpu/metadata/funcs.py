@@ -26,8 +26,14 @@ def _svc(upid: UInt128):
     return mdstate.snapshot().service_of_upid(upid)
 
 
-def _host(name, args, out, fn):
-    return ScalarUDF(name=name, arg_types=args, out_type=out, fn=fn, device=False)
+def _host(name, args, out, fn, volatile=True):
+    # volatile: fns reading the ambient K8sSnapshot bake stale LUTs into
+    # cached kernels when the metadata epoch advances (the cache signature
+    # includes the epoch for chains that call them).  Pure fns (upid field
+    # extractors, string splitters) pass volatile=False so epoch churn does
+    # not force needless re-jits.
+    return ScalarUDF(name=name, arg_types=args, out_type=out, fn=fn, device=False,
+                     volatile=volatile)
 
 
 def register_metadata_funcs(r: Registry) -> None:
@@ -51,9 +57,9 @@ def register_metadata_funcs(r: Registry) -> None:
                      lambda u: (_pod(u).owner_deployment if _pod(u) else "")))
     r.register(_host("upid_to_cmdline", (_U,), _S,
                      lambda u: mdstate.snapshot().upid_to_cmdline.get(u, "")))
-    r.register(_host("upid_to_pid", (_U,), _I, lambda u: u.pid))
-    r.register(_host("upid_to_asid", (_U,), _I, lambda u: u.asid))
-    r.register(_host("upid_to_string", (_U,), _S, str))
+    r.register(_host("upid_to_pid", (_U,), _I, lambda u: u.pid, volatile=False))
+    r.register(_host("upid_to_asid", (_U,), _I, lambda u: u.asid, volatile=False))
+    r.register(_host("upid_to_string", (_U,), _S, str, volatile=False))
 
     # ---- pod/service/ip lookups
     r.register(_host("pod_id_to_pod_name", (_S,), _S,
@@ -65,7 +71,8 @@ def register_metadata_funcs(r: Registry) -> None:
     r.register(_host("pod_id_to_service_name", (_S,), _S, _pod_id_to_service_name))
     r.register(_host("pod_name_to_pod_id", (_S,), _S, _pod_name_to_pod_id))
     r.register(_host("pod_name_to_namespace", (_S,), _S,
-                     lambda qn: qn.split("/", 1)[0] if "/" in qn else ""))
+                     lambda qn: qn.split("/", 1)[0] if "/" in qn else "",
+                     volatile=False))
     r.register(_host("pod_name_to_service_name", (_S,), _S,
                      lambda qn: _pod_id_to_service_name(_pod_name_to_pod_id(qn))))
     r.register(_host("pod_name_to_pod_status", (_S,), _S,

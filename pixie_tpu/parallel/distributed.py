@@ -132,6 +132,11 @@ class DistributedPlanner:
                 len(children) == 1
                 and isinstance(children[0], AggOp)
                 and len(logical.parents(children[0])) == 1
+                # A limited chain must NOT cut at the agg: each agent would
+                # admit its own n rows, feeding up to k*n rows into the
+                # distributed aggregate.  Ship rows instead — the merger
+                # re-applies the limit below, then aggregates exactly n rows.
+                and not any(isinstance(op, LimitOp) for op in chain)
             ):
                 cut_agg = children[0]
 
@@ -163,6 +168,16 @@ class DistributedPlanner:
                 rs = RemoteSourceOp(channel=cid)
                 merger_plan.add(rs)
                 lowered[cur.id] = rs
+                # Re-apply any limit on the merger side: each agent enforces
+                # head(n) over ITS rows, so k producers ship up to k*n rows —
+                # the merger must cut back to n (reference LimitPushdownRule
+                # keeps the original limit on the Kelvin side while copying it
+                # to PEMs, limit_push_down_rule.cc).
+                limit_ns = [op.n for op in chain if isinstance(op, LimitOp)]
+                if limit_ns:
+                    lim = LimitOp(n=min(limit_ns))
+                    merger_plan.add(lim, parents=[rs])
+                    lowered[cur.id] = lim
                 self._lower_rest(logical, cur, lowered, lower_downstream)
 
         # Materialize agent plans.

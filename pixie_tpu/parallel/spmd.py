@@ -74,9 +74,11 @@ def collective_merge_carry(carry, new_state, reduce_tree, axis_name: str):
 def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
     """Lift a single-device agg step into an SPMD step over `mesh`.
 
-    raw_step(cols, n_valid, t_lo, t_hi, limit, luts, state) -> (state, count)
+    raw_step(cols, n_valid, t_lo, t_hi, limits, luts, state) -> (state, count)
     is the UNJITTED kernel from ChainKernel.make_agg_step (each device sees its
-    local shard).  The lifted step takes:
+    local shard).  `limits` is the kernel's per-LimitOp budget vector
+    (ChainKernel.init_limits()); a scalar broadcasts one shared budget and is
+    only correct for chains with ≤1 limit.  The lifted step takes:
       cols        — leading dim sharded over `axis` ([n_dev, rows_per_dev, ...])
       n_valid     — int64[n_dev], per-shard valid counts
       state       — replicated identity-initialized state

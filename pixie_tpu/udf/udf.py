@@ -47,6 +47,10 @@ class ScalarUDF:
     #: http_resp_message): (lo, hi) inclusive — evaluated once over the domain
     #: into a device LUT instead of needing a dictionary-encoded input.
     int_domain: tuple[int, int] | None = None
+    #: True for host fns reading ambient mutable state (the k8s metadata
+    #: snapshot): their baked LUTs go stale when the state epoch advances, so
+    #: kernel caches must key on the epoch (see executor._chain_cache_sig).
+    volatile: bool = False
 
     def key(self) -> tuple:
         return (self.name, self.arg_types)
@@ -395,6 +399,10 @@ class Registry:
 
     def has_scalar(self, name: str) -> bool:
         return name in self._scalar
+
+    def is_volatile(self, name: str) -> bool:
+        """Any overload of `name` reads ambient mutable state (metadata)."""
+        return any(o.volatile for o in self._scalar.get(name, ()))
 
     # uda
     def register_uda(self, name: str, factory: Callable[[], UDA]):

@@ -376,10 +376,28 @@ px.display(df)
 
 
 def test_script_sandbox(store):
-    with pytest.raises(ImportError):
+    # Foreign imports and host builtins are rejected at AST validation, before
+    # any code runs (ADVICE r1: exec of query text must be gated).
+    with pytest.raises(CompilerError):
         compile_pxl("import os\n", store.schemas(), now=NOW)
     with pytest.raises(NameError):
         compile_pxl("open('/etc/passwd')\n", store.schemas(), now=NOW)
+    # The attribute-traversal escape (().__class__.__base__.__subclasses__())
+    # dies on the underscored-attribute rule.
+    with pytest.raises(CompilerError):
+        compile_pxl(
+            "x = ().__class__.__base__.__subclasses__()\n", store.schemas(), now=NOW
+        )
+    with pytest.raises(CompilerError):
+        compile_pxl("x = __builtins__\n", store.schemas(), now=NOW)
+    # Host-control statements are outside the dialect.
+    for bad in ("while True:\n    pass\n",
+                "with open('x') as f:\n    pass\n",
+                "try:\n    x = 1\nexcept Exception:\n    pass\n",
+                "class A:\n    pass\n",
+                "global x\n"):
+        with pytest.raises(CompilerError):
+            compile_pxl(bad, store.schemas(), now=NOW)
 
 
 def test_errors(store):
@@ -393,3 +411,40 @@ def test_errors(store):
             "import px\ndf = px.DataFrame(table='http_events')\n"
             "df = df[df.latency]\npx.display(df)",
             store.schemas(), now=NOW)
+
+
+def test_metadata_epoch_invalidates_kernel_cache(store, upids):
+    """A metadata update that grows no dictionary must still invalidate cached
+    chain kernels (ADVICE r1: pod rename served stale LUTs)."""
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df.pod = df.ctx['pod']
+df = df.groupby('pod').agg(cnt=('latency', px.count))
+px.display(df, 'out')
+"""
+    res, _ = run(store, src)
+    names0 = set(res["out"].to_pandas()["pod"])
+    assert "shop/cart-abc" in names0
+    from pixie_tpu.metadata import state as mdstate
+
+    # Rename pod-uid-0 in place: all strings already exist in no dictionary
+    # the QUERY reads (the upid dictionary is untouched), so only the epoch
+    # distinguishes the snapshots.
+    mdstate.global_manager().apply_updates(
+        [{"kind": "pod", "uid": "pod-uid-0", "name": "cart-renamed",
+          "namespace": "shop", "node": "node-1", "ip": "10.0.0.1"}]
+    )
+    res2, _ = run(store, src)
+    names1 = set(res2["out"].to_pandas()["pod"])
+    assert "shop/cart-renamed" in names1
+    assert "shop/cart-abc" not in names1
+
+
+def test_sandbox_format_blocked(store):
+    """format()'s replacement-field mini-language does attribute traversal
+    from string constants — both the builtin and the str method are blocked."""
+    with pytest.raises(CompilerError):
+        compile_pxl("x = '{0.a}'.format(1)\n", store.schemas(), now=NOW)
+    with pytest.raises((CompilerError, NameError)):
+        compile_pxl("x = format(1, 'd')\n", store.schemas(), now=NOW)

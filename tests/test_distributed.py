@@ -214,3 +214,42 @@ px.display(j)
     exp = oracle_df.groupby("service", as_index=False).agg(cnt=("latency", "count"))
     assert out.cnt.tolist() == exp.sort_values("service").cnt.tolist()
     assert (out.t_min == oracle_df.time_.min()).all()
+
+
+def test_distributed_head_limit_reapplied_at_merger(cluster):
+    """head(5) over 3 agents must return 5 rows, not 15 (ADVICE r1: the
+    splitter moved the limit into the agent fragment and never re-applied it
+    on the merger side)."""
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.head(5)
+px.display(df)
+"""
+    res = cluster.query(src, now=NOW)
+    assert res["output"].num_rows == 5
+
+
+def test_distributed_default_limit_reapplied_at_merger(cluster):
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+px.display(df)
+"""
+    res = cluster.query(src, now=NOW, default_limit=50)
+    assert res["output"].num_rows == 50
+
+
+def test_distributed_limit_before_agg(cluster):
+    """head(n) feeding an aggregate must aggregate exactly n rows cluster-wide
+    (the splitter may not cut a limited chain at the agg — each agent would
+    admit its own n)."""
+    src = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.head(5)
+df = df.groupby('service').agg(cnt=('latency', px.count))
+px.display(df)
+"""
+    res = cluster.query(src, now=NOW)
+    assert int(res["output"].to_pandas()["cnt"].sum()) == 5

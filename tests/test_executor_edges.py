@@ -85,3 +85,40 @@ def test_intdict_group_key_renamed(store):
     out = execute_plan(p, store)["output"]
     assert out.num_rows == 3000
     assert set(out.relation.names()) == {"k2", "s"}
+
+
+def test_independent_limit_budgets(store):
+    """head(10) → filter → head(5): each LimitOp tracks its OWN budget (ADVICE
+    r1: a min-collapsed shared budget admits only 5 pre-filter rows and
+    under-returns).  Expect: first 10 rows pass limit 1, filter keeps evens
+    {0,2,4,6,8}, limit 2 takes the first 5 of those."""
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t"))
+    l1 = p.add(LimitOp(n=10), parents=[src])
+    f = p.add(
+        FilterOp(expr=Call("equal", (Call("modulo", (Column("k"), lit(2))), lit(0)))),
+        parents=[l1],
+    )
+    l2 = p.add(LimitOp(n=5), parents=[f])
+    p.add(MemorySinkOp(name="output"), parents=[l2])
+    out = execute_plan(p, store)["output"]
+    np.testing.assert_array_equal(np.sort(out.columns["k"]), [0, 2, 4, 6, 8])
+
+
+def test_independent_limit_budgets_cross_batch():
+    """Same as above but with the filter killing whole early batches, so limit
+    budgets must carry independently across feed batches."""
+    ts = TableStore()
+    rel = Relation.of(("k", DT.INT64),)
+    t = ts.create("t2", rel, batch_rows=1024)
+    n = 5000
+    t.write({"k": np.arange(n, dtype=np.int64)})
+    p = Plan()
+    src = p.add(MemorySourceOp(table="t2"))
+    l1 = p.add(LimitOp(n=4000), parents=[src])
+    f = p.add(FilterOp(expr=Call("greater_equal", (Column("k"), lit(3000)))),
+              parents=[l1])
+    l2 = p.add(LimitOp(n=7), parents=[f])
+    p.add(MemorySinkOp(name="output"), parents=[l2])
+    out = execute_plan(p, ts)["output"]
+    np.testing.assert_array_equal(np.sort(out.columns["k"]), np.arange(3000, 3007))

@@ -1,0 +1,35 @@
+"""Time resolution tests (reference planner time-resolution rules)."""
+import datetime
+
+import pytest
+
+from pixie_tpu.compiler.timeparse import parse_duration_ns, resolve_time, SECOND
+
+NOW = 1_700_000_000_000_000_000
+
+
+def test_durations():
+    assert parse_duration_ns("-5m") == -300 * SECOND
+    assert parse_duration_ns("1h30m") == 5400 * SECOND
+    assert parse_duration_ns("250ms") == 250_000_000
+    with pytest.raises(ValueError):
+        parse_duration_ns("5x")
+
+
+def test_relative_resolution():
+    assert resolve_time("-30s", NOW) == NOW - 30 * SECOND
+    assert resolve_time(12345, NOW) == 12345
+
+
+def test_datetime_exact_ns():
+    """datetime → ns must be exact (ADVICE r1: float timestamp()*1e9 is only
+    ~us-accurate at current epochs, shifting boundary rows)."""
+    dt = datetime.datetime(2023, 11, 14, 22, 13, 20, 123456,
+                           tzinfo=datetime.timezone.utc)
+    want = 1_700_000_000 * SECOND + 123_456_000
+    assert resolve_time(dt, NOW) == want
+    # ISO string path hits the same exact conversion.
+    assert resolve_time("2023-11-14T22:13:20.123456+00:00", NOW) == want
+    # Naive datetimes resolve as UTC regardless of host TZ.
+    naive = datetime.datetime(2023, 11, 14, 22, 13, 20, 1)
+    assert resolve_time(naive, NOW) == 1_700_000_000 * SECOND + 1000
