@@ -410,25 +410,15 @@ class DataFrame:
             output.append(("right", n, out))
             schema[out] = right._schema[n]
 
-        # Engine join semantics (executor): parents=[build, probe]; build-side
-        # duplicate keys collapse, probe rows are preserved.  The left frame is
-        # typically the big/duplicated one, so it goes on the PROBE side:
-        # inner → build=right, probe=left (matched probe rows kept);
-        # left  → same placement with how="right" (all probe rows kept).
-        if how == "inner":
-            swapped = [("right" if s == "left" else "left", c, o) for s, c, o in output]
-            op = JoinOp(how="inner", left_on=ron, right_on=lon, output=swapped)
-            parents = [right._node, self._node]
-        elif how == "left":
-            swapped = [("right" if s == "left" else "left", c, o) for s, c, o in output]
-            op = JoinOp(how="right", left_on=ron, right_on=lon, output=swapped)
-            parents = [right._node, self._node]
-        elif how == "right":
-            op = JoinOp(how="right", left_on=lon, right_on=ron, output=output)
-            parents = [self._node, right._node]
-        else:
-            raise CompilerError(f"merge: how={how!r} not supported (inner/left/right)")
-        return self._derive(op, parents, schema, window=None)
+        # Engine join (executor._run_join) is symmetric with full m:n
+        # expansion and inner/left/right/outer, so `how` maps straight
+        # through (reference planpb JoinOperator, plan.proto:301-316).
+        if how not in ("inner", "left", "right", "outer"):
+            raise CompilerError(
+                f"merge: how={how!r} not supported (inner/left/right/outer)"
+            )
+        op = JoinOp(how=how, left_on=lon, right_on=ron, output=output)
+        return self._derive(op, [self._node, right._node], schema, window=None)
 
     def display(self, name: str = "output") -> None:
         sink = MemorySinkOp(name=name, columns=list(self._schema))

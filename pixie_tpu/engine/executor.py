@@ -335,7 +335,13 @@ class ChainKernel:
             else:  # limit; sv = budget index
                 # Scalar `limits` broadcasts one shared budget (SPMD callers
                 # pass INT64_MAX); the executor always passes the per-limit
-                # vector from init_limits().
+                # vector from init_limits().  Two limits sharing one scalar
+                # budget would silently mis-account, so reject at trace time.
+                if jnp.ndim(limits) == 0 and len(self.limit_ns) > 1:
+                    raise Internal(
+                        "chains with multiple LimitOps need the per-limit "
+                        "budget vector (ChainKernel.init_limits()), not a scalar"
+                    )
                 rem = limits[sv] if jnp.ndim(limits) else limits
                 reaching = jnp.sum(mask.astype(jnp.int64))
                 mask = mask & (jnp.cumsum(mask.astype(jnp.int64)) <= rem)
@@ -1032,6 +1038,18 @@ class PlanExecutor:
 
     # -------------------------------------------------------------------- join
     def _run_join(self, op: JoinOp) -> HostBatch:
+        """Equijoin with full many-to-many expansion, inner/left/right/outer.
+
+        Reference: exec/equijoin_node.h + planpb JoinOperator
+        (plan.proto:301-316).  Redesigned as a sort/searchsorted join over
+        factorized composite key codes (no hash table): the left side is
+        sorted once, each right row binary-searches its match range, and
+        m:n pairs expand with a repeat/offset vector — all O((n+m) log n)
+        columnar numpy, the same structure the device path reuses for the
+        unique-build fast case.  Null keys (dict code -1 or untranslatable
+        values) never match but their rows still surface as unmatched in
+        left/right/outer joins (pandas semantics).
+        """
         parents = self.plan.parents(op)
         if len(parents) != 2:
             raise Internal("join needs two parents")
@@ -1039,15 +1057,15 @@ class PlanExecutor:
         right = self._materialize_parent(parents[1])
         if len(op.left_on) != len(op.right_on) or not op.left_on:
             raise CompilerError("join requires equal, non-empty key lists")
+        if op.how not in ("inner", "left", "right", "outer"):
+            raise Unimplemented(f"join how={op.how!r}")
+        nl, nr = left.num_rows, right.num_rows
 
-        # Normalize keys to comparable numpy arrays (codes translated to the
-        # left dictionary space; raw values otherwise).  Null dict codes (-1,
-        # e.g. unmatched fills from an earlier left join or untranslatable
-        # values) must never equal each other, so they are masked out of both
-        # the build and probe sides.
-        lkeys, rkeys = [], []
-        lnull = np.zeros(left.num_rows, dtype=bool)
-        rnull = np.zeros(right.num_rows, dtype=bool)
+        # Factorize each key pair into a shared integer code space; nulls
+        # (dict code -1) are tracked separately and excluded from matching.
+        lcodes, rcodes = [], []
+        lnull = np.zeros(nl, dtype=bool)
+        rnull = np.zeros(nr, dtype=bool)
         for lk, rk in zip(op.left_on, op.right_on):
             lv, rv = left.cols[lk], right.cols[rk]
             ld, rd = left.dicts.get(lk), right.dicts.get(rk)
@@ -1058,51 +1076,34 @@ class PlanExecutor:
                 if rd is not ld:
                     rv = apply_lut_np(rd.translate_to(ld, insert=False), rv)
                 rnull |= rv < 0
-            lkeys.append(lv)
-            rkeys.append(rv)
+            lcodes.append(np.asarray(lv))
+            rcodes.append(np.asarray(rv))
+        lc, rc = _composite_codes(lcodes, rcodes)
 
-        # Host hash join via sorted unique composite keys.
-        lcomp = _composite(lkeys)
-        rcomp = _composite(rkeys)
-        uniq, linv = np.unique(lcomp, return_inverse=True)
-        ridx = np.searchsorted(uniq, rcomp)
-        ridx_c = np.clip(ridx, 0, max(len(uniq) - 1, 0))
-        found = (len(uniq) > 0) & (uniq[ridx_c] == rcomp) if len(uniq) else np.zeros(len(rcomp), bool)
-        found &= ~rnull
-        # Build: last VALID row per key wins (duplicate build keys collapse; the
-        # many-to-many expansion is the sort-merge upgrade).
-        build_row = np.full(len(uniq), -1, dtype=np.int64)
-        lvalid = np.nonzero(~lnull)[0]
-        build_row[linv[lvalid]] = lvalid
-        bidx = np.where(found, build_row[ridx_c], -1)
-
-        keep = bidx >= 0
-        if op.how == "inner":
-            rsel = np.nonzero(keep)[0]
-        elif op.how in ("right", "left_outer_probe"):
-            rsel = np.arange(len(rcomp))
-        else:
-            raise Unimplemented(f"join how={op.how!r} (inner/right supported)")
-        bsel = bidx[rsel]
+        lidx, ridx, l_matched, r_matched = _match_pairs(lc, rc, lnull, rnull)
+        lsel, rsel = [lidx], [ridx]
+        if op.how in ("left", "outer"):
+            lum = np.nonzero(~l_matched)[0]
+            lsel.append(lum)
+            rsel.append(np.full(len(lum), -1, dtype=np.int64))
+        if op.how in ("right", "outer"):
+            rum = np.nonzero(~r_matched)[0]
+            lsel.append(np.full(len(rum), -1, dtype=np.int64))
+            rsel.append(rum)
+        lsel = np.concatenate(lsel)
+        rsel = np.concatenate(rsel)
 
         dtypes, dicts, cols = {}, {}, {}
         outputs = op.output or _default_join_output(left, right)
         for side, col, out_name in outputs:
-            if side == "left":
-                src_b, arr = left, left.cols[col]
-                take = np.clip(bsel, 0, max(len(arr) - 1, 0))
-                v = arr[take] if len(arr) else np.zeros(len(bsel), arr.dtype)
-                miss = bsel < 0
-                if miss.any():
-                    v = v.copy()
-                    v[miss] = _null_value(src_b.dtypes[col])
-            else:
-                src_b, arr = right, right.cols[col]
-                v = arr[rsel]
+            src_b = left if side == "left" else right
+            sel = lsel if side == "left" else rsel
+            cols[out_name] = _take_with_nulls(
+                src_b.cols[col], sel, src_b.dtypes[col]
+            )
             dtypes[out_name] = src_b.dtypes[col]
             if col in src_b.dicts:
                 dicts[out_name] = src_b.dicts[col]
-            cols[out_name] = v
         return HostBatch(dtypes, dicts, cols)
 
     def _run_union(self, op: UnionOp) -> HostBatch:
@@ -1224,12 +1225,69 @@ def _prescan_unique(src, col: str, qd: Dictionary, sort: bool = False):
             qd.encode(np.unique(arr))
 
 
-def _composite(keys: list[np.ndarray]) -> np.ndarray:
-    """Combine key arrays into one comparable array (structured dtype)."""
-    if len(keys) == 1:
-        return keys[0]
-    rec = np.rec.fromarrays(keys)
-    return rec
+def _composite_codes(
+    lkeys: list[np.ndarray], rkeys: list[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray]:
+    """Factorize both sides' (multi-)key rows into one shared int64 code space
+    so matching reduces to integer comparison.
+
+    Each key pair factorizes separately FIRST (np.unique collapses NaN on 1-D
+    float arrays, giving pandas' NaN==NaN merge semantics), then the per-key
+    code columns combine — structured-array comparison over floats would treat
+    NaNs as distinct and make join behavior depend on key count.
+    """
+    nl = len(lkeys[0]) if lkeys else 0
+    per = []
+    for l, r in zip(lkeys, rkeys):
+        _u, inv = np.unique(np.concatenate([l, r]), return_inverse=True)
+        per.append(inv.astype(np.int64))
+    if len(per) == 1:
+        comb = per[0]
+    else:
+        _u, comb = np.unique(np.rec.fromarrays(per), return_inverse=True)
+        comb = comb.astype(np.int64)
+    return comb[:nl], comb[nl:]
+
+
+def _match_pairs(
+    lc: np.ndarray, rc: np.ndarray, lnull: np.ndarray, rnull: np.ndarray
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """All matching (left_row, right_row) pairs with m:n expansion.
+
+    Returns (lidx, ridx, l_matched[nl], r_matched[nr]).  Sort the valid left
+    rows by code; each valid right row finds its [lo, hi) match range by
+    binary search and contributes hi-lo pairs.
+    """
+    nl, nr = len(lc), len(rc)
+    lvalid = np.nonzero(~lnull)[0]
+    order = lvalid[np.argsort(lc[lvalid], kind="stable")]
+    sorted_keys = lc[order]
+    lo = np.searchsorted(sorted_keys, rc, side="left")
+    hi = np.searchsorted(sorted_keys, rc, side="right")
+    counts = np.where(rnull, 0, hi - lo)
+    total = int(counts.sum())
+    ridx = np.repeat(np.arange(nr, dtype=np.int64), counts)
+    offsets = np.cumsum(counts) - counts
+    within = np.arange(total, dtype=np.int64) - np.repeat(offsets, counts)
+    lidx = order[np.repeat(lo, counts) + within]
+    l_matched = np.zeros(nl, dtype=bool)
+    l_matched[lidx] = True
+    r_matched = counts > 0
+    return lidx, ridx, l_matched, r_matched
+
+
+def _take_with_nulls(arr: np.ndarray, sel: np.ndarray, dt: DT) -> np.ndarray:
+    """arr[sel] with sel == -1 producing the type's null fill."""
+    if len(arr) == 0:
+        out = np.zeros(len(sel), dtype=arr.dtype)
+        miss = np.ones(len(sel), dtype=bool)
+    else:
+        out = arr[np.clip(sel, 0, len(arr) - 1)]
+        miss = sel < 0
+    if miss.any():
+        out = out.copy()
+        out[miss] = _null_value(dt)
+    return out
 
 
 def _default_join_output(left: HostBatch, right: HostBatch):

@@ -199,10 +199,10 @@ class MemorySinkOp(Operator):
 @dataclasses.dataclass
 class JoinOp(Operator):
     """Equijoin (reference exec/equijoin_node.*, planpb JoinOperator
-    plan.proto:301-316). Parents: [left(build), right(probe)] for how="right"
-    semantics see engine.executor."""
+    plan.proto:301-316). Parents: [left, right]; symmetric m:n expansion
+    (engine.executor._run_join)."""
 
-    how: str = "inner"  # inner | left
+    how: str = "inner"  # inner | left | right | outer
     left_on: list[str] = dataclasses.field(default_factory=list)
     right_on: list[str] = dataclasses.field(default_factory=list)
     #: output columns as (side, col, out_name); side in {"left","right"}

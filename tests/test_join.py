@@ -1,0 +1,213 @@
+"""Join semantics oracle tests vs pandas.merge.
+
+Parity target: reference exec/equijoin_node.* + end_to_end_join_test.cc —
+inner/left/right/outer with full many-to-many expansion, duplicate keys on both
+sides, and null keys (which never match but survive as unmatched rows).
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.compiler import compile_fn
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+HOWS = ["inner", "left", "right", "outer"]
+
+
+def build_stores(lrows, rrows):
+    """Two tables with string + int columns; separate dictionaries."""
+    ts = TableStore()
+    lt = ts.create(
+        "lhs", Relation.of(("k", DT.STRING), ("ki", DT.INT64), ("lv", DT.FLOAT64))
+    )
+    lt.write(lrows)
+    rt = ts.create(
+        "rhs", Relation.of(("k", DT.STRING), ("ki", DT.INT64), ("rv", DT.FLOAT64))
+    )
+    rt.write(rrows)
+    return ts
+
+
+def run_merge(ts, how, left_on, right_on):
+    def build(px):
+        l = px.DataFrame(table="lhs")
+        r = px.DataFrame(table="rhs")
+        return l.merge(r, how=how, left_on=left_on, right_on=right_on)
+
+    q = compile_fn(build, ts.schemas())
+    return execute_plan(q.plan, ts)["output"].to_pandas()
+
+
+def oracle(ts, how, left_on, right_on):
+    """pandas merge mirroring the engine's output shape: BOTH sides of a
+    colliding column are kept (suffixed), keys included — pandas would
+    otherwise coalesce same-named keys into one column."""
+    frames = {}
+    for name in ("lhs", "rhs"):
+        t = ts.table(name)
+        cols = {}
+        for c in t.relation:
+            parts = []
+            for rb, _, _ in t.cursor():
+                arr = rb.columns[c.name][: rb.num_valid]
+                if c.name in t.dictionaries:
+                    parts.extend(t.dictionaries[c.name].decode(arr))
+                else:
+                    parts.extend(arr.tolist())
+            cols[c.name] = parts
+        frames[name] = pd.DataFrame(cols)
+    lon = [left_on] if isinstance(left_on, str) else list(left_on)
+    ron = [right_on] if isinstance(right_on, str) else list(right_on)
+    collisions = set(frames["lhs"].columns) & set(frames["rhs"].columns)
+    l = frames["lhs"].rename(columns={c: c + "_x" for c in collisions})
+    r = frames["rhs"].rename(columns={c: c + "_y" for c in collisions})
+    lon = [c + "_x" if c in collisions else c for c in lon]
+    ron = [c + "_y" if c in collisions else c for c in ron]
+    return l.merge(r, how=how, left_on=lon, right_on=ron)
+
+
+def norm(df, cols):
+    """Sort + normalize null representations for comparison: engine nulls are
+    '' / None for strings, 0 for ints, NaN for floats."""
+    out = df.copy()
+    for c in cols:
+        if pd.api.types.is_object_dtype(out[c]) or pd.api.types.is_string_dtype(out[c]):
+            out[c] = out[c].astype(object).fillna("").replace({None: ""})
+        elif pd.api.types.is_float_dtype(out[c]):
+            pass
+        else:
+            out[c] = out[c].fillna(0)
+    return (
+        out[cols]
+        .sort_values(cols, na_position="last")
+        .reset_index(drop=True)
+    )
+
+
+def assert_join_equal(got, exp):
+    cols = sorted(exp.columns)
+    # Engine INT64 null-fills with 0; pandas promotes missing ints to NaN
+    # float — align the oracle to the engine's representation.
+    exp = exp.copy()
+    for c in cols:
+        if pd.api.types.is_integer_dtype(got[c]) and pd.api.types.is_float_dtype(exp[c]):
+            exp[c] = exp[c].fillna(0).astype(np.int64)
+    g, e = norm(got, cols), norm(exp, cols)
+    assert len(g) == len(e), f"row count {len(g)} != oracle {len(e)}"
+    for c in cols:
+        if pd.api.types.is_float_dtype(e[c]):
+            np.testing.assert_allclose(
+                g[c].astype(float), e[c].astype(float), rtol=1e-12, equal_nan=True
+            )
+        else:
+            assert g[c].astype(str).tolist() == e[c].astype(str).tolist(), c
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_many_to_many_string_key(how):
+    # duplicates on BOTH sides → m:n expansion; plus keys unique to each side.
+    ts = build_stores(
+        {"k": ["a", "a", "b", "c", "c", "c", "only_l"],
+         "ki": [1, 2, 3, 4, 5, 6, 7],
+         "lv": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0]},
+        {"k": ["a", "b", "b", "c", "only_r"],
+         "ki": [10, 30, 31, 40, 99],
+         "rv": [0.1, 0.3, 0.31, 0.4, 0.9]},
+    )
+    got = run_merge(ts, how, "k", "k")
+    exp = oracle(ts, how, "k", "k")
+    assert_join_equal(got, exp)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_int_key_join(how):
+    ts = build_stores(
+        {"k": ["x"] * 6, "ki": [1, 1, 2, 3, 3, 9], "lv": np.arange(6.0)},
+        {"k": ["y"] * 5, "ki": [1, 2, 2, 3, 8], "rv": np.arange(5.0)},
+    )
+    got = run_merge(ts, how, "ki", "ki")
+    exp = oracle(ts, how, "ki", "ki")
+    assert_join_equal(got, exp)
+
+
+@pytest.mark.parametrize("how", HOWS)
+def test_multi_key_join(how):
+    rng = np.random.default_rng(5)
+    n = 300
+    ts = build_stores(
+        {"k": rng.choice(["a", "b", "c"], n).tolist(),
+         "ki": rng.integers(0, 4, n),
+         "lv": rng.normal(size=n)},
+        {"k": rng.choice(["b", "c", "d"], n).tolist(),
+         "ki": rng.integers(0, 4, n),
+         "rv": rng.normal(size=n)},
+    )
+    got = run_merge(ts, how, ["k", "ki"], ["k", "ki"])
+    exp = oracle(ts, how, ["k", "ki"], ["k", "ki"])
+    assert_join_equal(got, exp)
+
+
+def test_null_keys_never_match_but_survive_outer():
+    """Null string keys (dict code -1 via an unmatched earlier left join) do
+    not pair with each other; their rows still appear in left/outer output."""
+    # Build nulls by joining through a first left-merge that misses.
+    ts = TableStore()
+    a = ts.create("a", Relation.of(("k", DT.STRING), ("v", DT.INT64)))
+    a.write({"k": ["p", "q"], "v": [1, 2]})
+    b = ts.create("b", Relation.of(("k", DT.STRING), ("name", DT.STRING)))
+    b.write({"k": ["p"], "name": ["P"]})
+    c = ts.create("c", Relation.of(("name", DT.STRING), ("w", DT.INT64)))
+    c.write({"name": ["P", "Z"], "w": [10, 20]})
+
+    def build(px):
+        l = px.DataFrame(table="a")
+        r = px.DataFrame(table="b")
+        j = l.merge(r, how="left", left_on="k", right_on="k")
+        # j.name is null for k='q'; join on name must NOT match anything.
+        rr = px.DataFrame(table="c")
+        return j.merge(rr, how="left", left_on="name", right_on="name")
+
+    q = compile_fn(build, ts.schemas())
+    out = execute_plan(q.plan, ts)["output"].to_pandas()
+    assert len(out) == 2
+    byk = out.set_index("k_x")
+    assert byk.loc["p", "w"] == 10
+    assert byk.loc["q", "w"] == 0  # null fill, not a bogus match
+
+
+def test_empty_sides():
+    ts = build_stores(
+        {"k": [], "ki": [], "lv": []},
+        {"k": ["a"], "ki": [1], "rv": [1.0]},
+    )
+    for how, want in (("inner", 0), ("left", 0), ("right", 1), ("outer", 1)):
+        got = run_merge(ts, how, "k", "k")
+        assert len(got) == want, how
+
+
+@pytest.mark.parametrize("how", ["inner", "outer"])
+def test_nan_float_keys_match_like_pandas(how):
+    """NaN float keys match each other (pandas merge semantics), regardless of
+    whether the key is single or part of a multi-key — factorization collapses
+    NaN per key before combining."""
+    ts = TableStore()
+    lt = ts.create("lhs", Relation.of(("a", DT.FLOAT64), ("b", DT.INT64),
+                                      ("lv", DT.INT64)))
+    lt.write({"a": [np.nan, 1.0, 2.0], "b": [1, 1, 2], "lv": [10, 11, 12]})
+    rt = ts.create("rhs", Relation.of(("a", DT.FLOAT64), ("b", DT.INT64),
+                                      ("rv", DT.INT64)))
+    rt.write({"a": [np.nan, 1.0, 3.0], "b": [1, 1, 3], "rv": [20, 21, 23]})
+
+    def build(px):
+        l = px.DataFrame(table="lhs")
+        r = px.DataFrame(table="rhs")
+        return l.merge(r, how=how, left_on=["a", "b"], right_on=["a", "b"])
+
+    q = compile_fn(build, ts.schemas())
+    out = execute_plan(q.plan, ts)["output"].to_pandas()
+    matched = out[(out.lv == 10) & (out.rv == 20)]
+    assert len(matched) == 1  # (NaN, 1) joined (NaN, 1)
+    if how == "inner":
+        assert len(out) == 2  # plus (1.0, 1)
