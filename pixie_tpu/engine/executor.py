@@ -486,7 +486,8 @@ def _first_len(cols: dict) -> int:
 
 
 class PlanExecutor:
-    def __init__(self, plan: Plan, table_store, registry=None, inputs=None):
+    def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
+                 mesh="auto"):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -497,6 +498,15 @@ class PlanExecutor:
         self.inputs: dict[str, HostBatch] = inputs or {}
         self._materialized: dict[int, HostBatch] = {}
         self.stats = {"rows_scanned": 0, "rows_output": 0, "batches": 0, "compile_s": 0.0}
+        # Device mesh for SPMD aggregation: every unlimited agg shards its
+        # feeds over all local devices and merges state with in-program
+        # collectives (the reference's per-PEM fan-out + Kelvin merge becomes
+        # mesh axes + psum — SURVEY §2.5).  "auto" = all local devices when >1.
+        if mesh == "auto":
+            from pixie_tpu.parallel.spmd import default_mesh
+
+            mesh = default_mesh()
+        self.mesh = mesh
 
     # ------------------------------------------------------------ plan walking
     def _upstream_chain(self, op):
@@ -551,12 +561,15 @@ class PlanExecutor:
 
         target = max(cap, FEED_ROWS)
         table_id = src.table.uid
+        # SPMD queries cache feeds SHARDED over the mesh (zero resharding on
+        # repeat queries); single-device queries cache default placement.
+        n_dev = self.mesh.size if self.mesh is not None else 1
 
         def emit(parts, gens, n):
             # Sealed-only feeds are immutable → serve/place them from the HBM
             # feed cache; anything touching the hot remainder streams fresh.
             cacheable = all(g is not None for g in gens)
-            dkey = (table_id, tuple(gens), tuple(names)) if cacheable else None
+            dkey = (table_id, tuple(gens), tuple(names), n_dev) if cacheable else None
             if dkey is not None:
                 cached = _device_cache_get(dkey)
                 if cached is not None:
@@ -578,7 +591,14 @@ class PlanExecutor:
                     off += len(a)
                 cols[k] = buf
             if dkey is not None:
-                dev = jax.device_put(cols)
+                if self.mesh is not None and bucket % n_dev == 0:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+                    from pixie_tpu.parallel.spmd import AGENT_AXIS
+
+                    sh = NamedSharding(self.mesh, P(AGENT_AXIS))
+                    dev = {k: jax.device_put(v, sh) for k, v in cols.items()}
+                else:
+                    dev = jax.device_put(cols)
                 _device_cache_put(dkey, dev)
                 cols = dict(dev)
             return cols, n
@@ -841,7 +861,7 @@ class PlanExecutor:
         # the table's rows_written in the signature.
         sig = None
         if isinstance(head, MemorySourceOp):
-            extra = ["agg", _op_sig(op)]
+            extra = ["agg", _op_sig(op), ("mesh", self.mesh.size if self.mesh else 0)]
             data_dependent = not all(g in dicts for g in op.groups)
             if data_dependent:
                 # intdevice key sets / window origins bake data; rows_written
@@ -853,7 +873,7 @@ class PlanExecutor:
         cached = _cache_get(sig)
         if cached is not None:
             (kern, keys, udas, in_types, init_specs, num_groups,
-             seen_name, step, partial_step, merge_fn) = cached
+             seen_name, step, partial_step, merge_fn, spmd_step) = cached
             state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
         else:
             kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
@@ -898,8 +918,22 @@ class PlanExecutor:
             step = kern.make_agg_step(keys, udas, num_groups)
             partial_step = kern.make_partial_agg_step(keys, udas, num_groups, init_specs)
             merge_fn = kern.make_merge_states(udas)
+            spmd_step = None
+            if self.mesh is not None:
+                from pixie_tpu.parallel.spmd import reduce_tree_for, spmd_partial_step
+
+                reduce_tree = reduce_tree_for(udas)
+                specs = list(init_specs)
+
+                def init_fn(specs=specs, g=num_groups):
+                    return {name: uda.init(g, in_dt) for name, uda, in_dt in specs}
+
+                spmd_step = spmd_partial_step(
+                    kern.raw_agg_step, init_fn, reduce_tree,
+                    len(kern.limit_ns), self.mesh,
+                )
             _cache_put(sig, (kern, keys, udas, in_types, init_specs, num_groups,
-                             seen_name, step, partial_step, merge_fn))
+                             seen_name, step, partial_step, merge_fn, spmd_step))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
         if kern.has_limit:
@@ -916,10 +950,23 @@ class PlanExecutor:
             # inside the trace), merged in one stacked reduction.  Dependent
             # executions serialize badly on remote runtimes; this keeps the
             # device pipeline flat: N parallel steps + 1 merge + 1 readback.
-            partials = [
-                partial_step(cols, np.int64(n_valid), t_lo, t_hi, luts)
-                for cols, n_valid in self._feed(src, names, cap)
-            ]
+            # With a mesh, each feed shards row-wise over ALL devices and
+            # merges per-device state in-program via psum/pmin/pmax (the
+            # reference's PEM-partial → Kelvin-finalize, but over ICI).
+            partials = []
+            n_dev = self.mesh.size if self.mesh is not None else 1
+            for cols, n_valid in self._feed(src, names, cap):
+                bucket = _first_len(cols)
+                if spmd_step is not None and bucket % n_dev == 0:
+                    from pixie_tpu.parallel.spmd import per_shard_valid
+
+                    nv = per_shard_valid(n_valid, bucket, n_dev)
+                    partials.append(spmd_step(cols, nv, t_lo, t_hi, luts))
+                    self.stats["spmd_feeds"] = self.stats.get("spmd_feeds", 0) + 1
+                else:
+                    partials.append(
+                        partial_step(cols, np.int64(n_valid), t_lo, t_hi, luts)
+                    )
             if len(partials) == 1:
                 state = partials[0]
             elif partials:

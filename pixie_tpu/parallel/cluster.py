@@ -53,10 +53,11 @@ class LocalCluster:
     """N agents with private table stores + one merger, in one process."""
 
     def __init__(self, stores: dict, merger_store: Optional[TableStore] = None,
-                 registry=None, n_devices_per_agent: int = 1):
+                 registry=None, n_devices_per_agent: Optional[int] = None):
         self.stores = dict(stores)
         self.merger_store = merger_store or TableStore()
         self.registry = registry
+        self._meshes: dict = {}
         agents = [
             AgentInfo(
                 name=name,
@@ -83,6 +84,21 @@ class LocalCluster:
     def schemas(self) -> dict:
         return self.spec.combined_schemas()
 
+    def _agent_mesh(self, agent_name: str):
+        """Resolve an agent's device mesh from AgentInfo.n_devices:
+        None = all local devices ("auto"), 1 = single device, N = N-device."""
+        info = next(a for a in self.spec.agents if a.name == agent_name)
+        n = info.n_devices
+        if n is None:
+            return "auto"
+        if n <= 1:
+            return None
+        if n not in self._meshes:
+            from pixie_tpu.parallel.spmd import make_mesh
+
+            self._meshes[n] = make_mesh(n)
+        return self._meshes[n]
+
     def query(self, pxl_source: str, func: Optional[str] = None,
               func_args: Optional[dict] = None, now: Optional[int] = None,
               default_limit: Optional[int] = None) -> dict[str, QueryResult]:
@@ -97,10 +113,12 @@ class LocalCluster:
     def execute(self, logical: Plan) -> dict[str, QueryResult]:
         dp = self.planner.plan(logical)
 
-        # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan).
+        # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
+        #    each SPMD over the agent's device mesh (AgentInfo.n_devices).
         payloads: dict[str, list] = {cid: [] for cid in dp.channels}
         for agent_name, plan in dp.agent_plans.items():
-            ex = PlanExecutor(plan, self.stores[agent_name], self.registry)
+            ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
+                              mesh=self._agent_mesh(agent_name))
             for cid, payload in ex.run_agent().items():
                 if isinstance(payload, PartialAggBatch):
                     # round-trip the wire format on every query

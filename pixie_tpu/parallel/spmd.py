@@ -36,6 +36,29 @@ def make_mesh(n_devices: int | None = None, axis: str = AGENT_AXIS) -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+_DEFAULT_MESH: Mesh | None = None
+_DEFAULT_MESH_READY = False
+
+
+def default_mesh() -> Mesh | None:
+    """Process-wide mesh over ALL local devices, or None when single-device /
+    disabled via PIXIE_TPU_SPMD=0.  This is what the engine's real query path
+    shards over (the reference's per-PEM fan-out becomes mesh axes)."""
+    global _DEFAULT_MESH, _DEFAULT_MESH_READY
+    if not _DEFAULT_MESH_READY:
+        import os
+
+        _DEFAULT_MESH_READY = True
+        n = len(jax.devices())
+        # Clamp to a power of two: feed buckets are pow2-sized, so a 6-device
+        # mesh would fail every `bucket % n_dev == 0` gate and silently
+        # disable SPMD; a 4-device mesh actually runs.
+        n = 1 << (n.bit_length() - 1)
+        if os.environ.get("PIXIE_TPU_SPMD", "auto") != "0" and n > 1:
+            _DEFAULT_MESH = make_mesh(n)
+    return _DEFAULT_MESH
+
+
 def reduce_tree_for(udas: list) -> dict:
     """State-structure-matching tree of reduce ops for a list of
     (out_name, UDA, value_builder) triples (the executor's agg spec)."""
@@ -101,6 +124,41 @@ def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P(), P(), P()),
         out_specs=(P(), P()),
+    )
+    return jax.jit(shard)
+
+
+def spmd_partial_step(raw_step, init_state_fn, reduce_tree, n_limits: int,
+                      mesh: Mesh, axis: str = AGENT_AXIS):
+    """Lift an agg kernel into the engine's SPMD per-feed partial step.
+
+    Unlike spmd_agg_step (which threads an explicit replicated state for the
+    streaming/carry case), this is the shape the real query path uses: each
+    feed is an INDEPENDENT execution — identity state created inside the
+    trace, per-device partial update over the feed's local 1-D shard, then an
+    in-program collective merge (psum/pmin/pmax over ICI).  The host merges
+    feeds afterwards with ChainKernel.make_merge_states.
+
+      lifted(cols, n_valid, t_lo, t_hi, luts) -> replicated merged state
+        cols:    1-D padded columns sharded over `axis` (length % n_dev == 0)
+        n_valid: int64[n_dev] per-shard valid counts, sharded over `axis`
+    """
+    import jax.numpy as jnp
+
+    def local(cols, n_valid, t_lo, t_hi, luts):
+        state = init_state_fn()
+        limits = jnp.full((max(1, n_limits),), np.iinfo(np.int64).max,
+                          dtype=jnp.int64)
+        new_state, cnt, _consumed = raw_step(
+            cols, n_valid[0], t_lo, t_hi, limits, luts, state
+        )
+        return collective_merge(new_state, reduce_tree, axis)
+
+    shard = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P()),
+        out_specs=P(),
     )
     return jax.jit(shard)
 

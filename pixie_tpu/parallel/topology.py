@@ -31,8 +31,10 @@ class AgentInfo:
     #: sources whose table an agent lacks — reference
     #: prune_unavailable_sources_rule.cc)
     schemas: dict = dataclasses.field(default_factory=dict)
-    #: devices in this agent's local mesh (1 = single chip)
-    n_devices: int = 1
+    #: devices in this agent's local mesh: None = all local devices (auto),
+    #: 1 = single chip, N = an explicit N-device mesh.  The executor shards
+    #: the agent's fragment feeds over this mesh (engine.executor._agg_state).
+    n_devices: Optional[int] = None
 
     def has_table(self, name: str) -> bool:
         return name in self.schemas

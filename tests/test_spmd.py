@@ -136,3 +136,78 @@ def test_collective_merge_tree():
     assert int(out["cnt"][0]) == 6
     assert float(out["lo"][0]) == 1.0
     assert float(out["avg"]["sum"][0]) == 4.0
+
+
+def test_plan_executor_real_query_path_is_spmd(rng):
+    """VERDICT r1 #1: the engine's real query path (not just the lifter) must
+    shard agg feeds over the mesh — and produce results identical to
+    single-device execution."""
+    from pixie_tpu.compiler import compile_pxl
+    from pixie_tpu.engine.executor import PlanExecutor
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("service", DT.STRING),
+                      ("latency", DT.FLOAT64), ("status", DT.INT64))
+    t = ts.create("http_events", rel, batch_rows=2048)
+    n = 50_000
+    now = 1_700_000_000_000_000_000
+    t.write({"time_": now - np.arange(n, dtype=np.int64)[::-1],
+             "service": rng.choice(["a", "b", "c"], n).tolist(),
+             "latency": rng.exponential(5.0, n),
+             "status": rng.choice([200, 404], n)})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.status != 404]\n"
+        "df = df.groupby('service').agg(cnt=('latency', px.count),"
+        " s=('latency', px.sum), lo=('latency', px.min), p50=('latency', px.p50))\n"
+        "px.display(df)\n",
+        ts.schemas(), now=now,
+    )
+    ex = PlanExecutor(q.plan, ts)  # mesh="auto" → 8 virtual devices
+    assert ex.mesh is not None and ex.mesh.size == N_DEV
+    out = ex.run()["output"]
+    assert out.exec_stats.get("spmd_feeds", 0) > 0, "agg did not shard over mesh"
+
+    single = PlanExecutor(q.plan, ts, mesh=None).run()["output"]
+    a = out.to_pandas().sort_values("service").reset_index(drop=True)
+    b = single.to_pandas().sort_values("service").reset_index(drop=True)
+    assert a.cnt.tolist() == b.cnt.tolist()
+    np.testing.assert_allclose(a.s.values, b.s.values, rtol=1e-12)
+    np.testing.assert_allclose(a.lo.values, b.lo.values, rtol=1e-12)
+    np.testing.assert_allclose(a.p50.values, b.p50.values, rtol=1e-12)
+
+
+def test_local_cluster_agents_run_spmd(rng):
+    """LocalCluster agents shard over their AgentInfo mesh; explicit
+    n_devices_per_agent builds bounded meshes."""
+    from pixie_tpu.parallel import LocalCluster
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    now = 1_700_000_000_000_000_000
+    stores = {}
+    for name in ("pem0", "pem1"):
+        ts = TableStore()
+        t = ts.create("http_events", Relation.of(
+            ("time_", DT.TIME64NS), ("service", DT.STRING), ("latency", DT.FLOAT64)
+        ), batch_rows=1024)
+        n = 20_000
+        t.write({"time_": now - np.arange(n, dtype=np.int64)[::-1],
+                 "service": rng.choice(["x", "y"], n).tolist(),
+                 "latency": rng.exponential(3.0, n)})
+        stores[name] = ts
+    cl = LocalCluster(stores)  # n_devices=None → auto mesh per agent
+    assert cl._agent_mesh("pem0") == "auto"
+    res = cl.query(
+        "import px\ndf = px.DataFrame(table='http_events')\n"
+        "df = df.groupby('service').agg(cnt=('latency', px.count))\npx.display(df)\n",
+        now=now,
+    )
+    assert int(res["output"].to_pandas()["cnt"].sum()) == 40_000
+
+    cl4 = LocalCluster(stores, n_devices_per_agent=4)
+    m = cl4._agent_mesh("pem0")
+    assert m is not None and m.size == 4
