@@ -1,0 +1,21 @@
+"""Collection layer: source connectors + poll-loop runtime (Stirling analog)."""
+from pixie_tpu.collect.core import (
+    Collector,
+    FrequencyManager,
+    SourceConnector,
+    TableSpec,
+)
+from pixie_tpu.collect.proc_stats import NetworkStatsConnector, ProcessStatsConnector
+from pixie_tpu.collect.replay import ReplayConnector
+from pixie_tpu.collect.seq_gen import SeqGenConnector
+
+__all__ = [
+    "Collector",
+    "FrequencyManager",
+    "SourceConnector",
+    "TableSpec",
+    "SeqGenConnector",
+    "ReplayConnector",
+    "ProcessStatsConnector",
+    "NetworkStatsConnector",
+]

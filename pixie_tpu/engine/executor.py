@@ -543,12 +543,19 @@ class PlanExecutor:
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
     # ------------------------------------------------------------- stream feed
-    def _feed(self, src, names, cap):
+    def _feed(self, src, names, cap, spmd: bool = False):
         """Yield (cols np dict padded, n_valid) host batches.
 
         Cursor batches (storage granularity) are coalesced into ~FEED_ROWS
         device feeds: fewer kernel dispatches and transfers, and the bucketed
         shapes repeat so XLA's shape cache stays warm.
+
+        spmd=True (the unlimited-agg path): cacheable feeds are placed SHARDED
+        over the mesh, so repeat SPMD queries stream zero bytes and reshard
+        nothing.  Single-device consumers (select/limit/join kernels) must NOT
+        receive sharded inputs — their jits would get implicitly
+        GSPMD-partitioned — so the placement (and the cache key) is gated on
+        the consumer.
         """
         if isinstance(src, HostBatch):
             n = src.num_rows
@@ -561,9 +568,7 @@ class PlanExecutor:
 
         target = max(cap, FEED_ROWS)
         table_id = src.table.uid
-        # SPMD queries cache feeds SHARDED over the mesh (zero resharding on
-        # repeat queries); single-device queries cache default placement.
-        n_dev = self.mesh.size if self.mesh is not None else 1
+        n_dev = self.mesh.size if (spmd and self.mesh is not None) else 1
 
         def emit(parts, gens, n):
             # Sealed-only feeds are immutable → serve/place them from the HBM
@@ -591,7 +596,7 @@ class PlanExecutor:
                     off += len(a)
                 cols[k] = buf
             if dkey is not None:
-                if self.mesh is not None and bucket % n_dev == 0:
+                if n_dev > 1 and bucket % n_dev == 0:
                     from jax.sharding import NamedSharding, PartitionSpec as P
                     from pixie_tpu.parallel.spmd import AGENT_AXIS
 
@@ -955,7 +960,8 @@ class PlanExecutor:
             # reference's PEM-partial → Kelvin-finalize, but over ICI).
             partials = []
             n_dev = self.mesh.size if self.mesh is not None else 1
-            for cols, n_valid in self._feed(src, names, cap):
+            for cols, n_valid in self._feed(src, names, cap,
+                                            spmd=spmd_step is not None):
                 bucket = _first_len(cols)
                 if spmd_step is not None and bucket % n_dev == 0:
                     from pixie_tpu.parallel.spmd import per_shard_valid

@@ -93,6 +93,12 @@ class LocalCluster:
             return "auto"
         if n <= 1:
             return None
+        # Clamp to a power of two: feed buckets are pow2-sized, so e.g. a
+        # 6-device mesh would fail every divisibility gate and silently run
+        # single-device (same clamp as spmd.default_mesh).
+        n = 1 << (n.bit_length() - 1)
+        if n <= 1:
+            return None
         if n not in self._meshes:
             from pixie_tpu.parallel.spmd import make_mesh
 
@@ -116,6 +122,7 @@ class LocalCluster:
         # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
         #    each SPMD over the agent's device mesh (AgentInfo.n_devices).
         payloads: dict[str, list] = {cid: [] for cid in dp.channels}
+        agent_stats: dict[str, dict] = {}
         for agent_name, plan in dp.agent_plans.items():
             ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
                               mesh=self._agent_mesh(agent_name))
@@ -124,6 +131,7 @@ class LocalCluster:
                     # round-trip the wire format on every query
                     payload = PartialAggBatch.from_bytes(payload.to_bytes())
                 payloads[cid].append(payload)
+            agent_stats[agent_name] = dict(ex.stats)
 
         # 2. merge channel payloads (reference: Kelvin finalize / row merge).
         inputs: dict[str, HostBatch] = {}
@@ -141,4 +149,9 @@ class LocalCluster:
 
         # 3. run the merger plan over the injected channels.
         ex = PlanExecutor(dp.merger_plan, self.merger_store, self.registry, inputs=inputs)
-        return ex.run()
+        results = ex.run()
+        # Per-agent exec stats ride along with every result (reference:
+        # AgentExecutionStats shipped with the final chunk, carnot.cc:227-275).
+        for r in results.values():
+            r.exec_stats["agents"] = agent_stats
+        return results

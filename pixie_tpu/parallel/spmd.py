@@ -143,7 +143,6 @@ def spmd_partial_step(raw_step, init_state_fn, reduce_tree, n_limits: int,
         cols:    1-D padded columns sharded over `axis` (length % n_dev == 0)
         n_valid: int64[n_dev] per-shard valid counts, sharded over `axis`
     """
-    import jax.numpy as jnp
 
     def local(cols, n_valid, t_lo, t_hi, luts):
         state = init_state_fn()

@@ -207,7 +207,14 @@ def test_local_cluster_agents_run_spmd(rng):
         now=now,
     )
     assert int(res["output"].to_pandas()["cnt"].sum()) == 40_000
+    # The agents really sharded over the mesh (stats ride with the result).
+    agents = res["output"].exec_stats["agents"]
+    assert set(agents) == {"pem0", "pem1"}
+    assert all(s.get("spmd_feeds", 0) > 0 for s in agents.values()), agents
 
     cl4 = LocalCluster(stores, n_devices_per_agent=4)
     m = cl4._agent_mesh("pem0")
     assert m is not None and m.size == 4
+    # Non-pow2 request clamps down rather than silently disabling SPMD.
+    cl6 = LocalCluster(stores, n_devices_per_agent=6)
+    assert cl6._agent_mesh("pem0").size == 4
