@@ -1,23 +1,33 @@
 """Distributed planner: logical plan → per-agent plans + channels.
 
-Reference architecture (src/carnot/planner/distributed/): Coordinator partitions
-by CarnotInfo, Splitter cuts the plan at blocking operators inserting
-GRPCSink/GRPCSourceGroup pairs (splitter/splitter.h:114-155), and
+Reference architecture (src/carnot/planner/distributed/): Coordinator
+partitions by CarnotInfo, Splitter cuts the plan at EVERY blocking boundary
+inserting GRPCSink/GRPCSourceGroup pairs (splitter/splitter.h:114-155), and
 PartialOperatorMgr splits aggregates into partial (data agents) + finalize
 (merger) (splitter/partial_op_mgr/).  This implementation mirrors those
 boundaries with a TPU-shaped data plane:
 
-  * source-side fragments (scan → map/filter/limit → [partial agg]) run on
-    every data agent holding the table, SPMD over the agent's local mesh;
-  * a "rows" channel ships compacted row batches; an "agg_state" channel ships
-    value-keyed per-group UDA state (each agent has its OWN dictionary code
-    space, so group keys cross agents as VALUES — the analog of the reference's
-    serialized-UDA partial rows);
-  * the merger re-aggregates the shipped state (pixie_tpu.parallel.partial) and
-    runs everything downstream of the cut.
+  * The AGENT-SIDE region is the maximal subgraph of scans + streamable ops
+    (map/filter/limit); every edge leaving it is a cut.
+  * An AggOp directly fed by an unlimited agent-side chain cuts as an
+    "agg_state" channel: the agents run the chain + a partial agg SPMD over
+    their mesh and ship value-keyed per-group UDA state (each agent has its
+    own dictionary code space, so keys cross agents as VALUES — the analog of
+    the reference's serialized-UDA partial rows, planpb plan.proto:250-257).
+  * Every other cut (join/union inputs, sinks, second-level aggs, limited
+    chains) is a "rows" channel; the merger re-applies any upstream limit
+    (reference LimitPushdownRule keeps the original on the Kelvin side).
+  * Agent plans are DAGs: a scan shared by several cut branches (e.g.
+    net_flow_graph's one source feeding two aggs) is cloned ONCE per agent
+    and fanned out.  Each branch still drives its own cursor, but device
+    feeds dedupe through the HBM feed cache, so repeated traversals stream
+    bytes once.
+  * Fragments go only to agents holding the fragment's table (reference
+    coordinator/prune_unavailable_sources_rule.cc).
 """
 from __future__ import annotations
 
+import copy
 import dataclasses
 import itertools
 from typing import Optional
@@ -37,6 +47,7 @@ from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
 from pixie_tpu.status import CompilerError
 
 _STREAMABLE = (MapOp, FilterOp, LimitOp)
+_INF = float("inf")
 
 
 @dataclasses.dataclass
@@ -88,115 +99,133 @@ class DistributedPlanner:
         merger = self.cluster.merger()
         chan_ids = itertools.count(0)
         channels: dict[str, Channel] = {}
-        # per data agent: list of (ops to add); built as op-chains
-        agent_frags: dict[str, list[list]] = {a.name: [] for a in self.cluster.agents}
         merger_plan = Plan()
-        #: logical op id -> merger plan op (for downstream reconstruction)
-        lowered: dict[int, object] = {}
 
-        def lower_downstream(op):
-            """Copy a logical op into the merger plan (parents must already be
-            lowered)."""
-            import copy
+        # ---- 1. classify the agent-side region + per-op upstream limit/table.
+        agent_side: set[int] = set()
+        min_limit: dict[int, float] = {}  # op id -> min LimitOp.n upstream
+        src_table: dict[int, str] = {}  # op id -> root table of its chain
+        for op in logical.topo_sorted():
+            if isinstance(op, MemorySourceOp):
+                agent_side.add(op.id)
+                min_limit[op.id] = _INF
+                src_table[op.id] = op.table
+            elif isinstance(op, _STREAMABLE):
+                ps = logical.parents(op)
+                if len(ps) == 1 and ps[0].id in agent_side:
+                    agent_side.add(op.id)
+                    lim = min_limit[ps[0].id]
+                    if isinstance(op, LimitOp):
+                        lim = min(lim, op.n)
+                    min_limit[op.id] = lim
+                    src_table[op.id] = src_table[ps[0].id]
 
-            parents = [lowered[p.id] for p in logical.parents(op)]
+        # ---- 2. per-agent DAG cloning (shared scans clone once).
+        agent_plans: dict[str, Plan] = {}
+        agent_ops: dict[str, dict[int, object]] = {}
+
+        def clone_into(agent: str, op):
+            m = agent_ops.setdefault(agent, {})
+            got = m.get(op.id)
+            if got is not None:
+                return got
+            parents = [clone_into(agent, p) for p in logical.parents(op)]
             c = copy.copy(op)
             c.id = -1
-            merger_plan.add(c, parents=parents)
-            lowered[op.id] = c
+            agent_plans.setdefault(agent, Plan()).add(c, parents=parents)
+            m[op.id] = c
             return c
 
-        # Walk sources: carve off the source-side fragment for each.
-        for src in logical.sources():
-            if not isinstance(src, MemorySourceOp):
-                raise CompilerError(f"distributed plan source must be a table scan, got {src.kind}")
-            producers = [a for a in self.cluster.data_agents(src.table)]
-            if not producers:
-                raise CompilerError(f"no agent has table {src.table!r}")
+        def producers_for(op) -> list[AgentInfo]:
+            table = src_table[op.id]
+            prods = self.cluster.data_agents(table)
+            if not prods:
+                raise CompilerError(f"no agent has table {table!r}")
+            return prods
 
-            chain = [src]
-            cur = src
-            while True:
-                children = logical.children(cur)
-                if len(children) != 1:
-                    break
-                nxt = children[0]
-                if isinstance(nxt, _STREAMABLE) and len(logical.parents(nxt)) == 1:
-                    chain.append(nxt)
-                    cur = nxt
-                    continue
-                break
-            children = logical.children(cur)
-            cut_agg = None
-            if (
-                len(children) == 1
-                and isinstance(children[0], AggOp)
-                and len(logical.parents(children[0])) == 1
-                # A limited chain must NOT cut at the agg: each agent would
-                # admit its own n rows, feeding up to k*n rows into the
-                # distributed aggregate.  Ship rows instead — the merger
-                # re-applies the limit below, then aggregates exactly n rows.
-                and not any(isinstance(op, LimitOp) for op in chain)
-            ):
-                cut_agg = children[0]
+        # ---- 3. cut every agent-side → non-agent-side edge.
+        lowered: dict[int, object] = {}  # logical id -> merger plan op
+        rows_channel_of: dict[int, str] = {}  # agent-side op id -> channel id
 
+        def cut_rows(p) -> None:
+            """Rows channel at agent-side op p (idempotent per p)."""
+            if p.id in rows_channel_of:
+                return
             cid = f"ch{next(chan_ids)}"
-            if cut_agg is not None:
-                # partial agg on agents; value-keyed state over the channel;
-                # merger re-aggregates (the finalize side).
-                import copy
+            rows_channel_of[p.id] = cid
+            prods = producers_for(p)
+            channels[cid] = Channel(cid, "rows", [a.name for a in prods])
+            for a in prods:
+                cp = clone_into(a.name, p)
+                agent_plans[a.name].add(
+                    ResultSinkOp(channel=cid, payload="rows"), parents=[cp]
+                )
+            rs = RemoteSourceOp(channel=cid)
+            merger_plan.add(rs)
+            lowered[p.id] = rs
+            # Re-apply any upstream limit on the merger side: each agent
+            # enforces head(n) over ITS rows, so k producers ship up to k*n.
+            lim = min_limit[p.id]
+            if lim != _INF:
+                lop = LimitOp(n=int(lim))
+                merger_plan.add(lop, parents=[rs])
+                lowered[p.id] = lop
 
-                partial = copy.copy(cut_agg)
+        def cut_agg(agg: AggOp, parent) -> None:
+            """Partial-agg channel: agents run chain + partial agg."""
+            cid = f"ch{next(chan_ids)}"
+            prods = producers_for(parent)
+            channels[cid] = Channel(
+                cid, "agg_state", [a.name for a in prods], agg=copy.copy(agg)
+            )
+            for a in prods:
+                cp = clone_into(a.name, parent)
+                partial = copy.copy(agg)
                 partial.id = -1
                 partial.partial = True
-                frag = [*chain, partial, ResultSinkOp(channel=cid, payload="agg_state")]
-                ch = Channel(cid, "agg_state", [a.name for a in producers],
-                             agg=copy.copy(cut_agg))
-                channels[cid] = ch
-                for a in producers:
-                    agent_frags[a.name].append(frag)
-                # merger side: the merged+finalized agg arrives as rows.
-                rs = RemoteSourceOp(channel=cid)
-                merger_plan.add(rs)
-                lowered[cut_agg.id] = rs
-                self._lower_rest(logical, cut_agg, lowered, lower_downstream)
-            else:
-                frag = [*chain, ResultSinkOp(channel=cid, payload="rows")]
-                channels[cid] = Channel(cid, "rows", [a.name for a in producers])
-                for a in producers:
-                    agent_frags[a.name].append(frag)
-                rs = RemoteSourceOp(channel=cid)
-                merger_plan.add(rs)
-                lowered[cur.id] = rs
-                # Re-apply any limit on the merger side: each agent enforces
-                # head(n) over ITS rows, so k producers ship up to k*n rows —
-                # the merger must cut back to n (reference LimitPushdownRule
-                # keeps the original limit on the Kelvin side while copying it
-                # to PEMs, limit_push_down_rule.cc).
-                limit_ns = [op.n for op in chain if isinstance(op, LimitOp)]
-                if limit_ns:
-                    lim = LimitOp(n=min(limit_ns))
-                    merger_plan.add(lim, parents=[rs])
-                    lowered[cur.id] = lim
-                self._lower_rest(logical, cur, lowered, lower_downstream)
+                ap = agent_plans[a.name]
+                ap.add(partial, parents=[cp])
+                ap.add(
+                    ResultSinkOp(channel=cid, payload="agg_state"),
+                    parents=[partial],
+                )
+            rs = RemoteSourceOp(channel=cid)
+            merger_plan.add(rs)
+            lowered[agg.id] = rs  # merged+finalized agg arrives as rows
 
-        # Materialize agent plans.
-        agent_plans: dict[str, Plan] = {}
-        for a in self.cluster.agents:
-            frags = agent_frags.get(a.name) or []
-            if not frags:
+        for op in logical.topo_sorted():
+            if op.id in agent_side:
                 continue
-            p = Plan()
-            import copy
+            parents = logical.parents(op)
+            if (
+                isinstance(op, AggOp)
+                and len(parents) == 1
+                and parents[0].id in agent_side
+                # A limited chain must NOT cut at the agg: each agent would
+                # admit its own n rows, feeding up to k*n rows into the
+                # distributed aggregate.  Ship rows; the merger re-applies
+                # the limit, then aggregates exactly n rows.
+                and min_limit[parents[0].id] == _INF
+            ):
+                cut_agg(op, parents[0])
+                continue
+            for p in parents:
+                if p.id in agent_side:
+                    cut_rows(p)
 
-            for frag in frags:
-                prev = None
-                for op in frag:
-                    c = copy.copy(op)
-                    c.id = -1
-                    p.add(c, parents=[prev] if prev is not None else [])
-                    prev = c
-            agent_plans[a.name] = p
+        # ---- 4. lower the remaining (merger-side) ops.
+        for op in logical.topo_sorted():
+            if op.id in agent_side or op.id in lowered:
+                continue
+            parents = logical.parents(op)
+            if not parents:
+                raise CompilerError(
+                    f"distributed plan source must be a table scan, got {op.kind}"
+                )
+            c = copy.copy(op)
+            c.id = -1
+            merger_plan.add(c, parents=[lowered[p.id] for p in parents])
+            lowered[op.id] = c
 
         return DistributedPlan(
             agent_plans=agent_plans,
@@ -204,15 +233,3 @@ class DistributedPlanner:
             channels=channels,
             merger=merger.name,
         )
-
-    def _lower_rest(self, logical: Plan, boundary, lowered: dict, lower_downstream):
-        """Lower everything strictly downstream of `boundary` into the merger
-        plan, in topological order, once all of an op's parents are lowered."""
-        for op in logical.topo_sorted():
-            if op.id in lowered:
-                continue
-            parents = logical.parents(op)
-            if not parents:
-                continue  # another source; handled by its own fragment walk
-            if all(p.id in lowered for p in parents):
-                lower_downstream(op)
