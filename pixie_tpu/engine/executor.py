@@ -22,6 +22,7 @@ fallback lands.
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import dataclasses
 import time as _time
 from typing import Optional
@@ -487,7 +488,7 @@ def _first_len(cols: dict) -> int:
 
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
-                 mesh="auto"):
+                 mesh="auto", analyze: bool = False):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -498,6 +499,14 @@ class PlanExecutor:
         self.inputs: dict[str, HostBatch] = inputs or {}
         self._materialized: dict[int, HostBatch] = {}
         self.stats = {"rows_scanned": 0, "rows_output": 0, "batches": 0, "compile_s": 0.0}
+        #: per-kernel / per-blocking-op timing records (the reference's
+        #: ExecNodeStats analog, exec_node.h:41; grain = compiled unit).
+        self.op_stats: list[dict] = []
+        self._stat_stack: list[dict] = []
+        #: analyze mode (reference ExecutePlan(analyze=true), carnot.cc:318):
+        #: synchronizes the device after every feed so per-kernel wall times
+        #: measure real execution, not async dispatch.
+        self.analyze = analyze
         # Device mesh for SPMD aggregation: every unlimited agg shards its
         # feeds over all local devices and merges state with in-program
         # collectives (the reference's per-PEM fan-out + Kelvin merge becomes
@@ -507,6 +516,47 @@ class PlanExecutor:
 
             mesh = default_mesh()
         self.mesh = mesh
+
+    # -------------------------------------------------------------- exec stats
+    @_contextlib.contextmanager
+    def _timed(self, label: str, ops: list[int]):
+        """Record a wall-time frame; nesting attributes child time so
+        self_ns = wall_ns - nested frames (exec_node.h self/total split).
+
+        The parent is captured at ENTER and the frame is removed by identity:
+        frames opened inside generators close at exhaustion/GC, not in LIFO
+        order, so a plain stack pop could discharge someone else's frame.
+        """
+        rec = {"ops": ops, "label": label, "wall_ns": 0, "rows_out": 0,
+               "bytes_out": 0, "_child_ns": 0}
+        parent = self._stat_stack[-1] if self._stat_stack else None
+        self._stat_stack.append(rec)
+        t0 = _time.perf_counter_ns()
+        try:
+            yield rec
+        finally:
+            rec["wall_ns"] = _time.perf_counter_ns() - t0
+            try:
+                self._stat_stack.remove(rec)
+            except ValueError:
+                pass
+            if parent is not None:
+                parent["_child_ns"] += rec["wall_ns"]
+            rec["self_ns"] = rec["wall_ns"] - rec.pop("_child_ns")
+            self.op_stats.append(rec)
+
+    def _chain_label(self, head, chain, terminal: str = "") -> str:
+        parts = []
+        if isinstance(head, MemorySourceOp):
+            parts.append(f"scan({head.table})")
+        elif isinstance(head, RemoteSourceOp):
+            parts.append(f"remote({head.channel})")
+        else:
+            parts.append(head.kind)
+        parts.extend(op.kind for op in chain)
+        if terminal:
+            parts.append(terminal)
+        return "->".join(parts)
 
     # ------------------------------------------------------------ plan walking
     def _upstream_chain(self, op):
@@ -636,20 +686,29 @@ class PlanExecutor:
         if got is not None:
             return got
         if isinstance(op, AggOp):
-            out = self._run_agg(op)
-        elif isinstance(op, JoinOp):
-            out = self._run_join(op)
-        elif isinstance(op, UnionOp):
-            out = self._run_union(op)
-        elif isinstance(op, MemorySourceOp):
-            out = self._consume_to_batch(op, [])
+            label = f"agg(by={op.groups})"
         elif isinstance(op, RemoteSourceOp):
-            got = self.inputs.get(op.channel)
-            if got is None:
-                raise Internal(f"no input injected for channel {op.channel!r}")
-            out = got
+            label = f"remote({op.channel})"
         else:
-            raise Internal(f"unexpected blocking op {op.kind}")
+            label = op.kind
+        with self._timed(label, [op.id]) as rec:
+            if isinstance(op, AggOp):
+                out = self._run_agg(op)
+            elif isinstance(op, JoinOp):
+                out = self._run_join(op)
+            elif isinstance(op, UnionOp):
+                out = self._run_union(op)
+            elif isinstance(op, MemorySourceOp):
+                out = self._consume_to_batch(op, [])
+            elif isinstance(op, RemoteSourceOp):
+                got = self.inputs.get(op.channel)
+                if got is None:
+                    raise Internal(f"no input injected for channel {op.channel!r}")
+                out = got
+            else:
+                raise Internal(f"unexpected blocking op {op.kind}")
+            rec["rows_out"] = out.num_rows
+            rec["bytes_out"] = sum(v.nbytes for v in out.cols.values())
         self._materialized[op.id] = out
         return out
 
@@ -731,34 +790,48 @@ class PlanExecutor:
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
 
+        label = self._chain_label(head, chain, "select")
+        op_ids = [head.id] + [op.id for op in chain]
+
         def gen():
             # Fully async pipeline: dispatch every feed's step with the limit
             # budgets carried as a DEVICE vector (no per-feed host sync), then
             # exactly two round-trips — one packed pull of the row counts, one
             # packed pull of the count-sliced outputs.  With a remote TPU each
             # readback costs a fixed RTT, so per-feed pulls would dominate.
-            has_limit = kern.has_limit
-            remaining = kern.init_limits()
-            feeds = []
-            for cols, n_valid in self._feed(src, names, cap):
-                outs, cnt, consumed = step(
-                    cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
-                )
-                if has_limit:
-                    # Only limit queries need the budget threaded (chains the
-                    # per-feed executions); unlimited scans stay independent.
-                    remaining = remaining - consumed
-                feeds.append((outs, cnt))
-            if not feeds:
-                return
-            cnts = transfer.pull([c for _, c in feeds])
-            sliced = [
-                {k: v[: int(c)] for k, v in outs.items()}
-                for (outs, _), c in zip(feeds, cnts)
-            ]
-            pulled = transfer.pull(sliced)
-            for cols_np, c in zip(pulled, cnts):
-                yield cols_np, int(c)
+            with self._timed(label, op_ids) as rec:
+                has_limit = kern.has_limit
+                remaining = kern.init_limits()
+                feeds = []
+                feed_ns = []
+                for cols, n_valid in self._feed(src, names, cap):
+                    tf0 = _time.perf_counter_ns()
+                    outs, cnt, consumed = step(
+                        cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
+                    )
+                    if has_limit:
+                        # Only limit queries need the budget threaded (chains
+                        # the per-feed executions); unlimited scans stay
+                        # independent.
+                        remaining = remaining - consumed
+                    if self.analyze:
+                        jax.block_until_ready(outs)
+                        feed_ns.append(_time.perf_counter_ns() - tf0)
+                    feeds.append((outs, cnt))
+                if self.analyze and feed_ns:
+                    rec["feed_ns"] = feed_ns
+                if not feeds:
+                    return
+                cnts = transfer.pull([c for _, c in feeds])
+                sliced = [
+                    {k: v[: int(c)] for k, v in outs.items()}
+                    for (outs, _), c in zip(feeds, cnts)
+                ]
+                pulled = transfer.pull(sliced)
+                for cols_np, c in zip(pulled, cnts):
+                    rec["rows_out"] += int(c)
+                    rec["bytes_out"] += sum(v.nbytes for v in cols_np.values())
+                    yield cols_np, int(c)
 
         return out_dtypes, out_dicts, out_names, gen()
 
@@ -941,6 +1014,19 @@ class PlanExecutor:
                              seen_name, step, partial_step, merge_fn, spmd_step))
         t_lo, t_hi = _time_bounds(head)
         luts = kern.luts
+        with self._timed(
+            self._chain_label(head, chain, "partial_agg"),
+            ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
+        ):
+            state_np = self._agg_feed_loop(
+                kern, step, partial_step, merge_fn, spmd_step, state,
+                src, names, cap, t_lo, t_hi, luts,
+            )
+        return keys, udas, state_np, seen_name, in_types
+
+    def _agg_feed_loop(self, kern, step, partial_step, merge_fn, spmd_step,
+                       state, src, names, cap, t_lo, t_hi, luts):
+        """Drive the feeds through the agg step and pull the final state."""
         if kern.has_limit:
             # Limit queries must thread the budgets, so the feed steps chain;
             # the budgets stay a device vector (no per-feed host sync).
@@ -950,6 +1036,8 @@ class PlanExecutor:
                     cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
                 )
                 remaining = remaining - consumed
+                if self.analyze:
+                    jax.block_until_ready(state)
         else:
             # No limit → per-feed partials are INDEPENDENT executions (init
             # inside the trace), merged in one stacked reduction.  Dependent
@@ -973,13 +1061,14 @@ class PlanExecutor:
                     partials.append(
                         partial_step(cols, np.int64(n_valid), t_lo, t_hi, luts)
                     )
+                if self.analyze:
+                    jax.block_until_ready(partials[-1])
             if len(partials) == 1:
                 state = partials[0]
             elif partials:
                 state = merge_fn(*partials)
 
-        state_np = transfer.pull(state)
-        return keys, udas, state_np, seen_name, in_types
+        return transfer.pull(state)
 
     def _decode_key_column(self, k: GroupKey, codes: np.ndarray):
         """Seen-group codes → (np column, dictionary|None) for key k."""
@@ -1029,6 +1118,7 @@ class PlanExecutor:
         """Execute an AGENT plan: returns {channel: payload} where payload is a
         HostBatch (rows channels) or PartialAggBatch (agg_state channels)."""
         out = {}
+        t0 = _time.perf_counter_ns()
         for sink in self.plan.sinks():
             if not isinstance(sink, ResultSinkOp):
                 raise Internal(f"agent plan sink {sink.kind} is not a ResultSink")
@@ -1039,6 +1129,8 @@ class PlanExecutor:
                 out[sink.channel] = self._partial_agg_batch(parent)
             else:
                 out[sink.channel] = self._materialize_parent(parent)
+        self.stats["wall_ns"] = _time.perf_counter_ns() - t0
+        self.stats["operators"] = self.op_stats
         return out
 
     def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None) -> HostBatch:
@@ -1187,6 +1279,7 @@ class PlanExecutor:
     # -------------------------------------------------------------------- run
     def run(self) -> dict[str, QueryResult]:
         results = {}
+        t0 = _time.perf_counter_ns()
         for sink in self.plan.sinks():
             if not isinstance(sink, MemorySinkOp):
                 raise Internal(f"plan sink {sink.kind} is not a MemorySink")
@@ -1213,6 +1306,11 @@ class PlanExecutor:
                 dictionaries={n: d for n, d in out_dicts.items()},
                 exec_stats=dict(self.stats),
             )
+        self.stats["wall_ns"] = _time.perf_counter_ns() - t0
+        self.stats["operators"] = self.op_stats
+        for r in results.values():
+            r.exec_stats["wall_ns"] = self.stats["wall_ns"]
+            r.exec_stats["operators"] = self.op_stats
         return results
 
 
@@ -1372,6 +1470,7 @@ def _dtype_of(arr) -> DT:
     return DT.STRING
 
 
-def execute_plan(plan: Plan, table_store, registry=None) -> dict[str, QueryResult]:
+def execute_plan(plan: Plan, table_store, registry=None,
+                 analyze: bool = False) -> dict[str, QueryResult]:
     """Compile + run a plan against a table store; returns {sink_name: QueryResult}."""
-    return PlanExecutor(plan, table_store, registry).run()
+    return PlanExecutor(plan, table_store, registry, analyze=analyze).run()

@@ -107,16 +107,17 @@ class LocalCluster:
 
     def query(self, pxl_source: str, func: Optional[str] = None,
               func_args: Optional[dict] = None, now: Optional[int] = None,
-              default_limit: Optional[int] = None) -> dict[str, QueryResult]:
+              default_limit: Optional[int] = None,
+              analyze: bool = False) -> dict[str, QueryResult]:
         """Compile a PxL script against the cluster's combined schemas and
         execute it distributed (the ExecuteScript analog)."""
         from pixie_tpu.compiler import compile_pxl
 
         q = compile_pxl(pxl_source, self.schemas(), func=func, func_args=func_args,
                         now=now, default_limit=default_limit)
-        return self.execute(q.plan)
+        return self.execute(q.plan, analyze=analyze)
 
-    def execute(self, logical: Plan) -> dict[str, QueryResult]:
+    def execute(self, logical: Plan, analyze: bool = False) -> dict[str, QueryResult]:
         dp = self.planner.plan(logical)
 
         # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
@@ -125,7 +126,7 @@ class LocalCluster:
         agent_stats: dict[str, dict] = {}
         for agent_name, plan in dp.agent_plans.items():
             ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
-                              mesh=self._agent_mesh(agent_name))
+                              mesh=self._agent_mesh(agent_name), analyze=analyze)
             for cid, payload in ex.run_agent().items():
                 if isinstance(payload, PartialAggBatch):
                     # round-trip the wire format on every query
@@ -148,7 +149,8 @@ class LocalCluster:
                 inputs[cid] = _union_host_batches(got)
 
         # 3. run the merger plan over the injected channels.
-        ex = PlanExecutor(dp.merger_plan, self.merger_store, self.registry, inputs=inputs)
+        ex = PlanExecutor(dp.merger_plan, self.merger_store, self.registry,
+                          inputs=inputs, analyze=analyze)
         results = ex.run()
         # Per-agent exec stats ride along with every result (reference:
         # AgentExecutionStats shipped with the final chunk, carnot.cc:227-275).

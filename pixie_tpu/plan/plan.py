@@ -319,6 +319,12 @@ class Plan:
             "edges": [[p, c] for p, cs in self._children.items() for c in cs],
         }
 
+    def explain(self) -> str:
+        """Pretty-print the DAG (reference src/carnot/plandebugger/)."""
+        from pixie_tpu.plan.debug import explain
+
+        return explain(self)
+
     @staticmethod
     def from_dict(d: dict) -> "Plan":
         p = Plan()
