@@ -59,6 +59,16 @@ from pixie_tpu.ops.groupby import next_pow2
 INT64_MIN = np.iinfo(np.int64).min
 INT64_MAX = np.iinfo(np.int64).max
 MAX_GROUPS = 1 << 22
+#: Sorted-fallback device reduction chunk (rows per update step).
+SORT_AGG_CHUNK = 1 << 20
+
+
+class GroupKeyFallback(Unimplemented):
+    """Raised when group keys are not expressible as bounded dense codes
+    (computed numeric keys, float keys, cardinality beyond MAX_GROUPS).
+    The executor catches it and reruns the aggregate through the sort-based
+    path (SURVEY §7 hard parts; reference capability: exec/agg_node.h's hash
+    map has no cardinality bound)."""
 MIN_BUCKET = 1 << 10
 #: Feed coalescing target: sealed storage batches (64K-ish, the reference's
 #: compaction granularity) are merged into large device feeds so a typical
@@ -540,7 +550,9 @@ class PlanExecutor:
                 self._stat_stack.remove(rec)
             except ValueError:
                 pass
-            if parent is not None:
+            if parent is not None and "_child_ns" in parent:
+                # A parent that already closed (abandoned generator finalized
+                # late) has popped its _child_ns; skip attribution then.
                 parent["_child_ns"] += rec["wall_ns"]
             rec["self_ns"] = rec["wall_ns"] - rec.pop("_child_ns")
             self.op_stats.append(rec)
@@ -888,10 +900,8 @@ class PlanExecutor:
             if sv.dtype in (DT.INT64, DT.TIME64NS, DT.BOOLEAN):
                 prov = kern.ctx.provenance.get(name)
                 if not isinstance(prov, Column):
-                    raise Unimplemented(
-                        f"group key {name!r} is a computed numeric column; only raw "
-                        "columns, dictionary columns and px.bin() windows can be "
-                        "grouped in this version"
+                    raise GroupKeyFallback(
+                        f"group key {name!r} is a computed numeric column"
                     )
                 # Device-side encoding: one prescan finds the uniques (sorted,
                 # so dictionary code == sorted position); the kernel then maps
@@ -913,20 +923,167 @@ class PlanExecutor:
                     )
                 )
                 continue
-            raise Unimplemented(f"cannot group by {name!r} of type {sv.dtype.name}")
+            raise GroupKeyFallback(f"group key {name!r} has type {sv.dtype.name}")
         total = 1
         for k in keys:
             total *= k.card
         if total > MAX_GROUPS:
-            raise Unimplemented(
-                f"group cardinality bound {total} exceeds {MAX_GROUPS}; "
-                "high-cardinality group-by needs the sort-based path"
+            raise GroupKeyFallback(
+                f"group cardinality bound {total} exceeds {MAX_GROUPS}"
             )
         return keys
 
     def _run_agg(self, op: AggOp) -> HostBatch:
-        keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        try:
+            keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        except GroupKeyFallback:
+            return self._run_agg_sorted(op)
         return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
+
+    # -------------------------------------------------- sort-based agg fallback
+    def _sorted_group_reduce(self, op: AggOp):
+        """Sort-based groupby for keys with no bounded dense code space.
+
+        Two phases, matching the SURVEY §7 design: (1) the chain's compiled
+        select kernel materializes group-key + value columns (device work);
+        (2) the host sorts/uniques the composite key — the analog of the
+        reference's unbounded hash map (exec/agg_node.h:55-140) — and the
+        per-group reduction goes back to the device as chunked masked segment
+        reductions over the exact group ids.
+
+        Returns (group_cols, dtypes, dicts, udas, in_types, state_np, G).
+        """
+        self.stats["sorted_agg_fallbacks"] = self.stats.get("sorted_agg_fallbacks", 0) + 1
+        parent = self.plan.parents(op)[0]
+        need = list(dict.fromkeys(
+            [*op.groups, *[ae.arg for ae in op.values if ae.arg is not None]]
+        ))
+        hb = self._consume_to_batch(parent, need)
+        cols, out_dtypes, out_dicts = hb.cols, hb.dtypes, hb.dicts
+        n = hb.num_rows
+
+        # ---- composite key factorization (host sort).
+        valid = np.ones(n, dtype=bool)
+        per_inv, per_card = [], []
+        for g in op.groups:
+            arr = cols[g]
+            if g in out_dicts:
+                valid &= arr >= 0  # null keys drop out (pandas dropna)
+            u, inv = np.unique(arr, return_inverse=True)
+            per_inv.append(inv.astype(np.int64))
+            per_card.append(len(u))
+        total_card = 1
+        for c in per_card:
+            total_card *= max(c, 1)
+        if total_card < (1 << 62):
+            comp = per_inv[0]
+            for inv, card in zip(per_inv[1:], per_card[1:]):
+                comp = comp * card + inv
+        else:
+            # mixed radix would overflow int64: unique over the record rows
+            _u, comp = np.unique(np.rec.fromarrays(per_inv), return_inverse=True)
+            comp = comp.astype(np.int64)
+        vrows = np.nonzero(valid)[0]
+        uniq_comp, first_in_valid = (
+            np.unique(comp[vrows], return_index=True)
+            if len(vrows)
+            else (np.empty(0, dtype=np.int64), np.empty(0, dtype=np.int64))
+        )
+        G = len(uniq_comp)
+        rep_rows = vrows[first_in_valid]  # one representative row per group
+        group_cols = {g: cols[g][rep_rows] for g in op.groups}
+        Gb = max(next_pow2(max(G, 1)), 1)
+        gid_np = np.searchsorted(uniq_comp, comp).clip(0, Gb - 1).astype(np.int32)
+
+        # ---- device reduction over exact gids, chunked.
+        udas, in_types, state = [], {}, {}
+        for ae in op.values:
+            uda = self.registry.uda(ae.fn)
+            in_dt = None
+            in_types[ae.out_name] = None
+            if ae.arg is not None:
+                if ae.arg in out_dicts:
+                    raise Unimplemented(
+                        f"aggregate {ae.fn} over string column {ae.arg!r}"
+                    )
+                in_types[ae.out_name] = out_dtypes[ae.arg]
+                in_dt = STORAGE_DTYPE[out_dtypes[ae.arg]]
+            elif not uda.nullary:
+                raise CompilerError(f"aggregate {ae.fn} requires an input column")
+            udas.append((ae.out_name, uda, ae.arg))
+            state[ae.out_name] = uda.init(Gb, in_dt)
+        val_names = sorted({vn for _o, _u, vn in udas if vn is not None})
+
+        def upd(state, gid, mask, vals):
+            new = {}
+            for out_name, uda, vname in udas:
+                v = vals[vname] if vname is not None else None
+                new[out_name] = uda.update(state[out_name], gid, v, mask, Gb)
+            return new
+
+        upd = jax.jit(upd, donate_argnums=(0,))
+        with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]):
+            for off in range(0, n, SORT_AGG_CHUNK):
+                end = min(off + SORT_AGG_CHUNK, n)
+                bucket = max(next_pow2(end - off), MIN_BUCKET)
+                gid_c = _pad(gid_np[off:end], bucket)
+                mask_c = np.zeros(bucket, dtype=bool)
+                mask_c[: end - off] = valid[off:end]
+                vals_c = {vn: _pad(cols[vn][off:end], bucket) for vn in val_names}
+                state = upd(state, gid_c, mask_c, vals_c)
+                if self.analyze:
+                    jax.block_until_ready(state)
+            state_np = transfer.pull(state)
+        return group_cols, out_dtypes, out_dicts, udas, in_types, state_np, G
+
+    def _run_agg_sorted(self, op: AggOp) -> HostBatch:
+        group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G = (
+            self._sorted_group_reduce(op)
+        )
+        dtypes: dict[str, DT] = {}
+        dicts: dict[str, Dictionary] = {}
+        cols: dict[str, np.ndarray] = {}
+        for g in op.groups:
+            dtypes[g] = in_dtypes[g]
+            cols[g] = group_cols[g]
+            if g in in_dicts:
+                dicts[g] = in_dicts[g]
+        for out_name, uda, _vn in udas:
+            full = uda.finalize_host(state_np[out_name])
+            vals = np.asarray(full)[:G]
+            out_dt = uda.out_type(in_types[out_name]) if not uda.nullary else uda.out_type(None)
+            if out_dt == DT.STRING:
+                d = Dictionary()
+                cols[out_name] = d.encode(vals)
+                dicts[out_name] = d
+            else:
+                cols[out_name] = vals.astype(STORAGE_DTYPE[out_dt], copy=False)
+            dtypes[out_name] = out_dt
+        return HostBatch(dtypes, dicts, cols)
+
+    def _sorted_partial_batch(self, op: AggOp):
+        """Distributed partial for the sorted path: group key VALUES + dense
+        state sliced to the seen groups (same wire shape as _partial_agg_batch)."""
+        from pixie_tpu.parallel.partial import PartialAggBatch
+
+        group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G = (
+            self._sorted_group_reduce(op)
+        )
+        key_cols, key_dtypes = {}, {}
+        for g in op.groups:
+            key_dtypes[g] = in_dtypes[g]
+            if g in in_dicts:
+                key_cols[g] = np.asarray(in_dicts[g].decode(group_cols[g]), dtype=object)
+            else:
+                key_cols[g] = group_cols[g]
+        states = {
+            out_name: jax.tree.map(lambda x: np.asarray(x)[:G], state_np[out_name])
+            for out_name, _uda, _vn in udas
+        }
+        return PartialAggBatch(
+            key_cols=key_cols, key_dtypes=key_dtypes, states=states,
+            in_types=dict(in_types),
+        )
 
     def _agg_state(self, op: AggOp):
         """Run the aggregation and pull the raw state (shared by the local
@@ -949,13 +1106,21 @@ class PlanExecutor:
                 head, chain, dtypes, dicts, extra, include_times=data_dependent
             )
         cached = _cache_get(sig)
+        if cached == "group_key_fallback":
+            # Remembered decision: skip the doomed kernel build + prescans
+            # (the fallback path rescans anyway).
+            raise GroupKeyFallback(f"agg {op.id}: cached fallback decision")
         if cached is not None:
             (kern, keys, udas, in_types, init_specs, num_groups,
              seen_name, step, partial_step, merge_fn, spmd_step) = cached
             state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
         else:
             kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
-            keys = self._plan_group_keys(op, kern, src, head)
+            try:
+                keys = self._plan_group_keys(op, kern, src, head)
+            except GroupKeyFallback:
+                _cache_put(sig, "group_key_fallback")
+                raise
             num_groups = 1
             for k in keys:
                 num_groups *= k.card
@@ -1084,7 +1249,10 @@ class PlanExecutor:
         (see pixie_tpu.parallel.partial.PartialAggBatch)."""
         from pixie_tpu.parallel.partial import PartialAggBatch
 
-        keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        try:
+            keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+        except GroupKeyFallback:
+            return self._sorted_partial_batch(op)
         seen_counts = np.asarray(state_np[seen_name])
         if keys:
             gids = np.nonzero(seen_counts > 0)[0]

@@ -1,0 +1,226 @@
+"""Sort-based high-cardinality groupby fallback vs pandas oracle.
+
+Covers the capability gap the dense-code path rejects (GroupKeyFallback):
+computed numeric keys, float keys, and cardinality beyond MAX_GROUPS.
+Reference capability: exec/agg_node.h's hash map has no cardinality bound.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.engine.executor import PlanExecutor
+from pixie_tpu.plan import (
+    AggExpr,
+    AggOp,
+    Call,
+    Column,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    lit,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+
+def _mkstore(n, ids, vals, extra=None):
+    ts = TableStore()
+    fields = [("time_", DT.TIME64NS), ("id", DT.INT64), ("v", DT.FLOAT64)]
+    data = {
+        "time_": np.arange(n, dtype=np.int64),
+        "id": ids,
+        "v": vals,
+    }
+    if extra:
+        for name, dt, arr in extra:
+            fields.append((name, dt))
+            data[name] = arr
+    rel = Relation.of(*fields)
+    t = ts.create("events", rel, batch_rows=1 << 15)
+    t.write(data)
+    return ts
+
+
+def _agg_plan(groups, values, map_exprs=None):
+    p = Plan()
+    src = p.add(MemorySourceOp(table="events"))
+    node = src
+    if map_exprs:
+        node = p.add(MapOp(exprs=map_exprs), parents=[src])
+    agg = p.add(AggOp(groups=groups, values=values), parents=[node])
+    p.add(MemorySinkOp(name="out"), parents=[agg])
+    return p
+
+
+def test_computed_numeric_key_falls_back_and_matches_pandas():
+    rng = np.random.default_rng(5)
+    n = 50_000
+    ids = rng.integers(0, 1000, n)
+    vals = rng.exponential(3.0, n)
+    ts = _mkstore(n, ids, vals)
+    # computed key: id % 7 (not a raw column → dense path rejects)
+    p = _agg_plan(
+        ["k"],
+        [AggExpr("cnt", "count", None), AggExpr("s", "sum", "v")],
+        map_exprs=[
+            ("k", Call("modulo", (Column("id"), lit(7)))),
+            ("v", Column("v")),
+        ],
+    )
+    ex = PlanExecutor(p, ts)
+    res = ex.run()["out"]
+    assert ex.stats.get("sorted_agg_fallbacks", 0) == 1
+    got = res.to_pandas().sort_values("k").reset_index(drop=True)
+    want = (
+        pd.DataFrame({"k": ids % 7, "v": vals})
+        .groupby("k")
+        .agg(cnt=("v", "count"), s=("v", "sum"))
+        .reset_index()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert (got["k"] == want["k"]).all()
+    assert (got["cnt"] == want["cnt"]).all()
+    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
+
+
+def test_million_distinct_groups_oracle():
+    rng = np.random.default_rng(6)
+    n = 2_200_000
+    n_groups = 1_100_000
+    ids = rng.permutation(np.arange(n) % n_groups)  # every group exactly twice
+    vals = rng.normal(10.0, 2.0, n)
+    ts = _mkstore(n, ids, vals)
+    # id*2+1 forces the computed-key fallback at full cardinality
+    p = _agg_plan(
+        ["k"],
+        [
+            AggExpr("cnt", "count", None),
+            AggExpr("s", "sum", "v"),
+            AggExpr("mn", "min", "v"),
+            AggExpr("mx", "max", "v"),
+        ],
+        map_exprs=[
+            ("k", Call("add", (Call("multiply", (Column("id"), lit(2))), lit(1)))),
+            ("v", Column("v")),
+        ],
+    )
+    res = execute_plan(p, ts)["out"]
+    df = res.to_pandas()
+    assert len(df) == len(np.unique(ids))
+    assert len(df) > 1_000_000
+    want = (
+        pd.DataFrame({"k": ids * 2 + 1, "v": vals})
+        .groupby("k")
+        .agg(cnt=("v", "count"), s=("v", "sum"), mn=("v", "min"), mx=("v", "max"))
+        .reset_index()
+    )
+    got = df.sort_values("k").reset_index(drop=True)
+    want = want.sort_values("k").reset_index(drop=True)
+    assert (got["k"].to_numpy() == want["k"].to_numpy()).all()
+    assert (got["cnt"].to_numpy() == want["cnt"].to_numpy()).all()
+    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
+    np.testing.assert_allclose(got["mn"], want["mn"], rtol=1e-12)
+    np.testing.assert_allclose(got["mx"], want["mx"], rtol=1e-12)
+
+
+def test_float_group_key():
+    rng = np.random.default_rng(7)
+    n = 10_000
+    ids = rng.integers(0, 50, n)
+    # float key with repeated values
+    fkey = (ids % 5).astype(np.float64) * 0.5
+    vals = rng.exponential(1.0, n)
+    ts = _mkstore(n, ids, vals, extra=[("fk", DT.FLOAT64, fkey)])
+    p = _agg_plan(["fk"], [AggExpr("cnt", "count", None), AggExpr("m", "mean", "v")])
+    res = execute_plan(p, ts)["out"]
+    got = res.to_pandas().sort_values("fk").reset_index(drop=True)
+    want = (
+        pd.DataFrame({"fk": fkey, "v": vals})
+        .groupby("fk")
+        .agg(cnt=("v", "count"), m=("v", "mean"))
+        .reset_index()
+        .sort_values("fk")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(got["fk"], want["fk"])
+    assert (got["cnt"] == want["cnt"]).all()
+    np.testing.assert_allclose(got["m"], want["m"], rtol=1e-9)
+
+
+def test_distributed_sorted_partial():
+    """Computed group keys in a distributed query: each agent takes the
+    sorted-fallback partial path and the merger reduces by key VALUES."""
+    from pixie_tpu.parallel.cluster import LocalCluster
+
+    rng = np.random.default_rng(8)
+    stores = {}
+    frames = []
+    for a in range(2):
+        n = 30_000
+        ids = rng.integers(0, 500, n)
+        vals = rng.exponential(2.0, n)
+        stores[f"pem{a}"] = _mkstore(n, ids, vals)
+        frames.append(pd.DataFrame({"id": ids, "v": vals}))
+    cluster = LocalCluster(stores)
+    script = """
+df = px.DataFrame(table='events')
+df.k = df.id % 9
+df = df.groupby('k').agg(cnt=('v', px.count), s=('v', px.sum))
+px.display(df, 'out')
+"""
+    res = cluster.query(script)["out"]
+    got = res.to_pandas().sort_values("k").reset_index(drop=True)
+    all_df = pd.concat(frames)
+    want = (
+        all_df.assign(k=all_df.id % 9)
+        .groupby("k")
+        .agg(cnt=("v", "count"), s=("v", "sum"))
+        .reset_index()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    assert (got["k"].to_numpy() == want["k"].to_numpy()).all()
+    assert (got["cnt"].to_numpy() == want["cnt"].to_numpy()).all()
+    np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
+
+
+def test_string_key_beyond_max_groups_card_bound():
+    """Two dict keys whose cardinality product exceeds MAX_GROUPS trigger the
+    fallback (not an error) and produce exact results."""
+    import pixie_tpu.engine.executor as exmod
+
+    rng = np.random.default_rng(9)
+    n = 20_000
+    ids = rng.integers(0, 100, n)
+    vals = rng.exponential(1.0, n)
+    svc = np.array([f"svc-{i}" for i in range(64)])[rng.integers(0, 64, n)]
+    path = np.array([f"/p/{i}" for i in range(64)])[rng.integers(0, 64, n)]
+    ts = _mkstore(
+        n, ids, vals,
+        extra=[("svc", DT.STRING, svc), ("path", DT.STRING, path)],
+    )
+    p = _agg_plan(["svc", "path"], [AggExpr("cnt", "count", None)])
+    old = exmod.MAX_GROUPS
+    exmod.MAX_GROUPS = 1024  # force the cardinality wall
+    try:
+        ex = PlanExecutor(p, ts)
+        res = ex.run()["out"]
+        assert ex.stats.get("sorted_agg_fallbacks", 0) == 1
+    finally:
+        exmod.MAX_GROUPS = old
+    got = res.to_pandas().sort_values(["svc", "path"]).reset_index(drop=True)
+    want = (
+        pd.DataFrame({"svc": svc, "path": path})
+        .groupby(["svc", "path"])
+        .size()
+        .rename("cnt")
+        .reset_index()
+        .sort_values(["svc", "path"])
+        .reset_index(drop=True)
+    )
+    assert (got["svc"] == want["svc"]).all()
+    assert (got["path"] == want["path"]).all()
+    assert (got["cnt"].to_numpy() == want["cnt"].to_numpy()).all()
