@@ -592,7 +592,13 @@ class PlanExecutor:
         """
         if isinstance(head, MemorySourceOp):
             table = self.store.table(head.table)
-            cursor = table.cursor(head.start_time, head.stop_time)
+            if head.since_row_id is not None or head.stop_row_id is not None:
+                cursor = table.cursor_since(
+                    head.since_row_id or 0, head.stop_row_id,
+                    head.start_time, head.stop_time,
+                )
+            else:
+                cursor = table.cursor(head.start_time, head.stop_time)
             visible = list(head.columns or table.relation.names())
             names = list(visible)
             has_bounds = head.start_time is not None or head.stop_time is not None
@@ -740,6 +746,10 @@ class PlanExecutor:
             return None
         table = self.store.table(head.table)
         src_sig = _op_sig(head)
+        # Row-id bounds are pure runtime cursor state (streaming resume
+        # tokens); kernels never bake them.
+        src_sig.pop("since_row_id", None)
+        src_sig.pop("stop_row_id", None)
         if not include_times:
             src_sig.pop("start_time", None)
             src_sig.pop("stop_time", None)
@@ -832,6 +842,14 @@ class PlanExecutor:
                     feeds.append((outs, cnt))
                 if self.analyze and feed_ns:
                     rec["feed_ns"] = feed_ns
+                if has_limit:
+                    # Surface each LimitOp's remaining budget (chain order) —
+                    # the streaming executor carries these across polls;
+                    # decrementing by emitted rows instead would over-deliver
+                    # when a filter follows a limit.
+                    rec["limit_remaining"] = [
+                        int(x) for x in np.asarray(jax.device_get(remaining))
+                    ]
                 if not feeds:
                     return
                 cnts = transfer.pull([c for _, c in feeds])
@@ -879,7 +897,10 @@ class PlanExecutor:
                     )
                 )
                 continue
-            wk = _window_key(kern.ctx.provenance.get(name))
+            # A bin key gets window-range semantics ONLY over the source time
+            # column — px.bin over a value column must go through the generic
+            # paths or it would collapse into bogus time-range bins.
+            wk = _window_key(kern.ctx.provenance.get(name), kern.time_col)
             if wk is not None and sv.dtype in (DT.TIME64NS, DT.INT64):
                 width = wk
                 t_min, t_max = _source_time_range(src, head)
@@ -969,6 +990,8 @@ class PlanExecutor:
             arr = cols[g]
             if g in out_dicts:
                 valid &= arr >= 0  # null keys drop out (pandas dropna)
+            elif arr.dtype.kind == "f":
+                valid &= ~np.isnan(arr)  # NaN keys drop out (pandas dropna)
             u, inv = np.unique(arr, return_inverse=True)
             per_inv.append(inv.astype(np.int64))
             per_card.append(len(u))
@@ -1014,14 +1037,28 @@ class PlanExecutor:
             state[ae.out_name] = uda.init(Gb, in_dt)
         val_names = sorted({vn for _o, _u, vn in udas if vn is not None})
 
-        def upd(state, gid, mask, vals):
-            new = {}
-            for out_name, uda, vname in udas:
-                v = vals[vname] if vname is not None else None
-                new[out_name] = uda.update(state[out_name], gid, v, mask, Gb)
-            return new
+        # The jitted update closure is cached per (registry, agg spec, Gb):
+        # jax.jit then reuses traces across calls/polls instead of recompiling
+        # the reduction every invocation.
+        upd_key = (
+            "sorted_upd", id(self.registry),
+            tuple((ae.out_name, ae.fn, ae.arg) for ae in op.values), Gb,
+        )
+        cached_upd = _cache_get(_json.dumps(upd_key))
+        if cached_upd is not None:
+            upd, udas = cached_upd
+        else:
+            spec = list(udas)
 
-        upd = jax.jit(upd, donate_argnums=(0,))
+            def upd(state, gid, mask, vals, spec=spec):
+                new = {}
+                for out_name, uda, vname in spec:
+                    v = vals[vname] if vname is not None else None
+                    new[out_name] = uda.update(state[out_name], gid, v, mask, Gb)
+                return new
+
+            upd = jax.jit(upd, donate_argnums=(0,))
+            _cache_put(_json.dumps(upd_key), (upd, udas))
         with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]):
             for off in range(0, n, SORT_AGG_CHUNK):
                 end = min(off + SORT_AGG_CHUNK, n)
@@ -1095,6 +1132,7 @@ class PlanExecutor:
         # origins) unless every group key is dictionary-backed; cover that with
         # the table's rows_written in the signature.
         sig = None
+        fb_sig = None
         if isinstance(head, MemorySourceOp):
             extra = ["agg", _op_sig(op), ("mesh", self.mesh.size if self.mesh else 0)]
             data_dependent = not all(g in dicts for g in op.groups)
@@ -1105,11 +1143,16 @@ class PlanExecutor:
             sig = self._chain_cache_sig(
                 head, chain, dtypes, dicts, extra, include_times=data_dependent
             )
-        cached = _cache_get(sig)
-        if cached == "group_key_fallback":
-            # Remembered decision: skip the doomed kernel build + prescans
-            # (the fallback path rescans anyway).
+            # The fallback DECISION memo is data-independent (no rows_written/
+            # times): once keys prove non-dense, falling back stays correct as
+            # the table grows — and streaming polls must hit this memo, not
+            # rebuild a doomed kernel per poll.
+            fb_sig = self._chain_cache_sig(
+                head, chain, dtypes, dicts, ["agg_fallback", _op_sig(op)]
+            )
+        if _cache_get(fb_sig) == "group_key_fallback":
             raise GroupKeyFallback(f"agg {op.id}: cached fallback decision")
+        cached = _cache_get(sig)
         if cached is not None:
             (kern, keys, udas, in_types, init_specs, num_groups,
              seen_name, step, partial_step, merge_fn, spmd_step) = cached
@@ -1119,7 +1162,7 @@ class PlanExecutor:
             try:
                 keys = self._plan_group_keys(op, kern, src, head)
             except GroupKeyFallback:
-                _cache_put(sig, "group_key_fallback")
+                _cache_put(fb_sig, "group_key_fallback")
                 raise
             num_groups = 1
             for k in keys:
@@ -1501,9 +1544,18 @@ def _time_bounds(head) -> tuple[np.int64, np.int64]:
     return np.int64(INT64_MIN), np.int64(INT64_MAX)
 
 
-def _window_key(expr) -> Optional[int]:
-    """Detect Call(bin, (time-ish, Literal w)) → window width, else None."""
-    if isinstance(expr, Call) and expr.fn == "bin" and len(expr.args) == 2:
+def _window_key(expr, time_col: Optional[str]) -> Optional[int]:
+    """Detect Call(bin, (Column(time_col), Literal w)) → window width, else
+    None.  The binned argument must be the source's time column — only then do
+    the baked t0_bin/nbins range semantics hold."""
+    if (
+        isinstance(expr, Call)
+        and expr.fn == "bin"
+        and len(expr.args) == 2
+        and time_col is not None
+        and isinstance(expr.args[0], Column)
+        and expr.args[0].name == time_col
+    ):
         w = expr.args[1]
         if isinstance(w, Literal) and isinstance(w.value, int) and w.value > 0:
             return int(w.value)

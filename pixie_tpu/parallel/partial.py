@@ -97,20 +97,22 @@ _NP_REDUCE = {
 }
 
 
-def merge_partials(
+def combine_partials(
     agg: AggOp, partials: list[PartialAggBatch], registry
-) -> HostBatch:
-    """Merge value-keyed partials from N producers and finalize → HostBatch.
+) -> PartialAggBatch:
+    """Reduce value-keyed partials from N producers into ONE partial batch.
 
-    The merge itself is a host-side segment reduction over the concatenated
-    group rows — states are tiny (seen groups only), so this stays off-device;
-    the heavy per-row work already happened on each producer's mesh.
+    Host-side segment reduction over the concatenated group rows — states are
+    tiny (seen groups only), so this stays off-device; the heavy per-row work
+    already happened on each producer's mesh.  The result is still raw state
+    (use finalize_partial), which is what lets the streaming executor carry
+    open-window state across polls and keep merging into it.
     """
     parts = [p for p in partials if p.num_groups > 0]
     if not parts:
         parts = [p for p in partials[:1]]
     if not parts:
-        raise InvalidArgument("merge_partials: no partial batches")
+        raise InvalidArgument("combine_partials: no partial batches")
     first = parts[0]
     keys = list(first.key_cols)
 
@@ -135,20 +137,9 @@ def merge_partials(
         g = 1
         first_idx = np.zeros(1, np.int64)
 
-    out_cols: dict[str, np.ndarray] = {}
-    out_dtypes: dict[str, DT] = {}
-    out_dicts: dict[str, Dictionary] = {}
-    for k in keys:
-        dt = first.key_dtypes[k]
-        vals = cols_cat[k][first_idx]
-        out_dtypes[k] = dt
-        if dt in (DT.STRING, DT.UINT128):
-            d = Dictionary()
-            out_cols[k] = d.encode(vals.tolist())
-            out_dicts[k] = d
-        else:
-            out_cols[k] = np.asarray(vals.tolist(), dtype=STORAGE_DTYPE[dt])
+    key_cols = {k: cols_cat[k][first_idx] for k in keys}
 
+    states: dict = {}
     for ae in agg.values:
         uda = registry.uda(ae.fn)
         ops_tree = uda.reduce_ops()
@@ -173,12 +164,60 @@ def merge_partials(
                 return {k: walk(ops_t[k], [t[k] for t in trees]) for k in ops_t}
             return merge_leaf(ops_t, trees)
 
-        merged_state = walk(ops_tree, [p.states[ae.out_name] for p in parts])
+        states[ae.out_name] = walk(ops_tree, [p.states[ae.out_name] for p in parts])
+
+    return PartialAggBatch(
+        key_cols=key_cols,
+        key_dtypes=dict(first.key_dtypes),
+        states=states,
+        in_types=dict(first.in_types),
+    )
+
+
+def slice_partial(pb: PartialAggBatch, idx: np.ndarray) -> PartialAggBatch:
+    """Subset of a partial batch's groups (streaming window close/retain)."""
+    return PartialAggBatch(
+        key_cols={k: np.asarray(v)[idx] for k, v in pb.key_cols.items()},
+        key_dtypes=dict(pb.key_dtypes),
+        states={
+            name: _map_tree(lambda x: np.asarray(x)[idx], tree)
+            for name, tree in pb.states.items()
+        },
+        in_types=dict(pb.in_types),
+    )
+
+
+def _map_tree(fn, tree):
+    if isinstance(tree, dict):
+        return {k: _map_tree(fn, v) for k, v in tree.items()}
+    return fn(tree)
+
+
+def finalize_partial(
+    agg: AggOp, pb: PartialAggBatch, registry
+) -> HostBatch:
+    """Finalize one (already combined) partial batch → result rows."""
+    g = pb.num_groups
+    out_cols: dict[str, np.ndarray] = {}
+    out_dtypes: dict[str, DT] = {}
+    out_dicts: dict[str, Dictionary] = {}
+    for k, vals in pb.key_cols.items():
+        dt = pb.key_dtypes[k]
+        out_dtypes[k] = dt
+        if dt in (DT.STRING, DT.UINT128):
+            d = Dictionary()
+            out_cols[k] = d.encode(np.asarray(vals, dtype=object).tolist())
+            out_dicts[k] = d
+        else:
+            out_cols[k] = np.asarray(
+                np.asarray(vals).tolist(), dtype=STORAGE_DTYPE[dt]
+            )
+    for ae in agg.values:
+        uda = registry.uda(ae.fn)
         # Re-init instance state for finalize (QuantileUDA binds its sketch in init).
-        uda.init(g, np.float64)
-        col = uda.finalize_host(merged_state)
-        in_t = first.in_types.get(ae.out_name)
-        out_dt = uda.out_type(in_t)
+        uda.init(max(g, 1), np.float64)
+        col = uda.finalize_host(pb.states[ae.out_name])
+        out_dt = uda.out_type(pb.in_types.get(ae.out_name))
         vals = np.asarray(col)
         out_dtypes[ae.out_name] = out_dt
         if out_dt == DT.STRING:
@@ -187,8 +226,14 @@ def merge_partials(
             out_dicts[ae.out_name] = d
         else:
             out_cols[ae.out_name] = vals.astype(STORAGE_DTYPE[out_dt], copy=False)
-
     return HostBatch(out_dtypes, out_dicts, out_cols)
+
+
+def merge_partials(
+    agg: AggOp, partials: list[PartialAggBatch], registry
+) -> HostBatch:
+    """Merge value-keyed partials from N producers and finalize → HostBatch."""
+    return finalize_partial(agg, combine_partials(agg, partials, registry), registry)
 
 
 def _np_identity(dtype, op: str):

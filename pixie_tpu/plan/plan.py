@@ -102,13 +102,19 @@ class Operator:
 
 @dataclasses.dataclass
 class MemorySourceOp(Operator):
-    """Scan a table-store cursor (reference exec/memory_source_node.cc:105)."""
+    """Scan a table-store cursor (reference exec/memory_source_node.cc:105).
+
+    since_row_id/stop_row_id bound the scan to a row-id range — the streaming
+    executor's resume token (reference: the cursor's persistent position for
+    `streaming` sources, table.h:76-124)."""
 
     table: str = ""
     columns: Optional[list[str]] = None  # None = all
     start_time: Optional[int] = None
     stop_time: Optional[int] = None
     streaming: bool = False
+    since_row_id: Optional[int] = None
+    stop_row_id: Optional[int] = None
 
     def _fields(self):
         return {
@@ -117,6 +123,8 @@ class MemorySourceOp(Operator):
             "start_time": self.start_time,
             "stop_time": self.stop_time,
             "streaming": self.streaming,
+            "since_row_id": self.since_row_id,
+            "stop_row_id": self.stop_row_id,
         }
 
 
@@ -354,6 +362,8 @@ def _op_from_dict(d: dict):
             start_time=d["start_time"],
             stop_time=d["stop_time"],
             streaming=d.get("streaming", False),
+            since_row_id=d.get("since_row_id"),
+            stop_row_id=d.get("stop_row_id"),
         )
     if k == "map":
         return MapOp(exprs=[(n, expr_from_dict(e)) for n, e in d["exprs"]])

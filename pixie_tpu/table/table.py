@@ -175,6 +175,63 @@ class Table:
             hot_row_id = self._next_row_id
         return Cursor(self, sealed, hot, hot_row_id, start_time, stop_time)
 
+    def last_row_id(self) -> int:
+        """Row id one past the newest row (streaming resume token source)."""
+        with self._lock:
+            return self._next_row_id + self._hot_rows
+
+    def cursor_since(
+        self,
+        row_id: int,
+        stop_row_id: int | None = None,
+        start_time: int | None = None,
+        stop_time: int | None = None,
+    ) -> "Cursor":
+        """Snapshot cursor over rows with row_id in [row_id, stop_row_id).
+
+        The streaming executor's incremental read (reference: `streaming`
+        MemorySource cursors persist their position, table.h:76-124): each
+        poll scans only the appended delta.  Rows expired from the ring
+        buffer are silently skipped (loss-by-design, as in the reference).
+        Partially-overlapping sealed batches are sliced; slices carry gen
+        None (not device-cacheable — their content is not a whole sealed gen).
+        """
+        with self._lock:
+            hi = (
+                stop_row_id
+                if stop_row_id is not None
+                else self._next_row_id + self._hot_rows
+            )
+            items: list[_SealedBatch] = []
+            for sb in self._sealed:
+                n = sb.batch.num_rows
+                lo_off = max(0, row_id - sb.row_id_start)
+                hi_off = min(n, hi - sb.row_id_start)
+                if hi_off <= 0 or lo_off >= n:
+                    continue
+                if lo_off == 0 and hi_off == n:
+                    items.append(sb)
+                else:
+                    rb = RowBatch(
+                        self.relation,
+                        {k: v[lo_off:hi_off] for k, v in sb.batch.columns.items()},
+                    )
+                    items.append(
+                        _SealedBatch(rb, sb.row_id_start + lo_off, self.time_col, gen=None)
+                    )
+            hot = None
+            hot_row_id = self._next_row_id
+            if self._hot_rows > 0:
+                lo_off = max(0, row_id - hot_row_id)
+                hi_off = min(self._hot_rows, hi - hot_row_id)
+                if hi_off > lo_off:
+                    merged = self._take_hot_locked()
+                    if lo_off > 0 or hi_off < self._hot_rows:
+                        merged = {k: v[lo_off:hi_off] for k, v in merged.items()}
+                    hot = RowBatch(self.relation, merged)
+                    hot_row_id += lo_off
+        return Cursor(self, items, hot, hot_row_id, start_time, stop_time)
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> dict:
         with self._lock:

@@ -150,6 +150,45 @@ def test_float_group_key():
     np.testing.assert_allclose(got["m"], want["m"], rtol=1e-9)
 
 
+def test_nan_float_keys_dropped():
+    """NaN group keys drop out (pandas dropna parity)."""
+    ids = np.arange(5)
+    fk = np.array([1.0, np.nan, 1.0, np.nan, 2.0])
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0])
+    ts = _mkstore(5, ids, vals, extra=[("fk", DT.FLOAT64, fk)])
+    p = _agg_plan(["fk"], [AggExpr("cnt", "count", None)])
+    res = execute_plan(p, ts)["out"]
+    got = res.to_pandas().sort_values("fk").reset_index(drop=True)
+    assert list(got["fk"]) == [1.0, 2.0]
+    assert list(got["cnt"]) == [2, 1]
+
+
+def test_bin_over_value_column_not_window():
+    """px.bin over a non-time column must NOT take baked window-range
+    semantics (which would collapse bins); it goes through the sorted path."""
+    rng = np.random.default_rng(11)
+    n = 5_000
+    ids = rng.integers(0, 1000, n)
+    vals = rng.exponential(1.0, n)
+    ts = _mkstore(n, ids, vals)
+    p = _agg_plan(
+        ["b"],
+        [AggExpr("cnt", "count", None)],
+        map_exprs=[("b", Call("bin", (Column("id"), lit(100)))), ("v", Column("v"))],
+    )
+    res = execute_plan(p, ts)["out"]
+    got = res.to_pandas().sort_values("b").reset_index(drop=True)
+    want = (
+        pd.DataFrame({"b": (ids // 100) * 100})
+        .groupby("b")
+        .size()
+        .rename("cnt")
+        .reset_index()
+    )
+    assert (got["b"].to_numpy() == want["b"].to_numpy()).all()
+    assert (got["cnt"].to_numpy() == want["cnt"].to_numpy()).all()
+
+
 def test_distributed_sorted_partial():
     """Computed group keys in a distributed query: each agent takes the
     sorted-fallback partial path and the merger reduces by key VALUES."""

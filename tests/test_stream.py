@@ -1,0 +1,245 @@
+"""Streaming execution: incremental polls, window close semantics, eos flush.
+
+Reference: eow/eos row-batch markers (exec_node.h:213-219), windowed agg
+emission (agg_node.h:88-91), streaming MemorySource cursors (table.h:76-124).
+"""
+import threading
+
+import numpy as np
+import pandas as pd
+
+from pixie_tpu.engine.stream import StreamQuery, stream_pxl
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+MS = 1_000_000
+SEC = 1_000_000_000
+
+
+def _store(batch_rows=1024):
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING), ("latency", DT.FLOAT64)
+    )
+    ts.create("http_events", rel, batch_rows=batch_rows)
+    return ts
+
+
+def _write(ts, t0, n, svc="a", lat=1.0):
+    t = ts.table("http_events")
+    t.write(
+        {
+            "time_": np.arange(t0, t0 + n, dtype=np.int64),
+            "service": [svc] * n,
+            "latency": np.full(n, lat),
+        }
+    )
+
+
+def test_chain_stream_incremental_polls():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events')
+df = df[df.latency > 0.5].stream()
+px.display(df, 'out')
+""",
+        ts,
+    )
+    assert sq.poll() == {}  # nothing yet
+    _write(ts, 0, 100, lat=1.0)
+    got = sq.poll()["out"]
+    assert got.num_rows == 100
+    # no new rows → no emission
+    assert sq.poll() == {}
+    _write(ts, 100, 50, lat=0.1)  # filtered out
+    assert sq.poll() == {}
+    _write(ts, 150, 30, lat=2.0)
+    assert sq.poll()["out"].num_rows == 30
+    assert sq.close() == {}
+
+
+def test_chain_stream_limit_reaches_eos():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.head(25)
+px.display(df, 'out')
+""",
+        ts,
+    )
+    _write(ts, 0, 10)
+    assert sq.poll()["out"].num_rows == 10
+    _write(ts, 10, 40)
+    assert sq.poll()["out"].num_rows == 15  # budget carried across polls
+    _write(ts, 50, 40)
+    assert sq.poll() == {}  # eos: limit exhausted
+
+
+def test_chain_stream_limit_then_filter_batch_parity():
+    """head(10) then a filter: the limit consumes rows even when the filter
+    drops them — batch semantics, no over-delivery across polls."""
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.head(10)
+df = df[df.latency > 0.5]
+px.display(df, 'out')
+""",
+        ts,
+    )
+    _write(ts, 0, 10, lat=0.1)  # limit consumes all 10, filter drops them
+    assert sq.poll() == {}
+    _write(ts, 10, 10, lat=2.0)  # budget exhausted: nothing may emit
+    assert sq.poll() == {}
+
+
+def test_stream_bin_over_value_column_emits_at_close():
+    """px.bin over a value column is NOT an event-time window: no watermark
+    dropping; emits once at close with exact totals."""
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df.lb = px.bin(df.time_ * 0 + 7, 100)
+df = df.groupby('lb').agg(cnt=('latency', px.count))
+px.display(df, 'out')
+""",
+        ts,
+    )
+    _write(ts, 0, 5)
+    assert sq.poll() == {}
+    _write(ts, 5, 3)
+    assert sq.poll() == {}
+    fin = sq.close()["out"].to_pandas()
+    assert list(fin["cnt"]) == [8]
+
+
+def test_windowed_stream_emits_closed_windows():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.rolling('1s').agg(cnt=('latency', px.count), s=('latency', px.sum))
+px.display(df, 'out')
+""",
+        ts,
+    )
+    t = ts.table("http_events")
+    # two full windows + part of a third
+    t.write({"time_": np.array([0, 100 * MS, 1 * SEC + 5, 1 * SEC + 10, 2 * SEC + 1]),
+             "service": ["a"] * 5, "latency": [1.0, 2.0, 3.0, 4.0, 5.0]})
+    got = sq.poll()["out"]
+    # windows [0,1s) and [1s,2s) closed (watermark in [2s,3s))
+    df = got.to_pandas().sort_values("time_").reset_index(drop=True)
+    assert list(df["time_"]) == [0, 1 * SEC]
+    assert list(df["cnt"]) == [2, 2]
+    assert list(df["s"]) == [3.0, 7.0]
+    # late row for an emitted window is dropped (exactly-once)
+    t.write({"time_": np.array([100]), "service": ["a"], "latency": [99.0]})
+    assert sq.poll() == {}
+    # close flushes the open [2s,3s) window
+    fin = sq.close()["out"].to_pandas()
+    assert list(fin["time_"]) == [2 * SEC]
+    assert list(fin["cnt"]) == [1]
+    assert list(fin["s"]) == [5.0]
+
+
+def test_windowed_stream_string_groups_across_polls():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.rolling('1s').agg(cnt=('latency', px.count))
+px.display(df, 'out')
+""",
+        ts,
+    )
+    t = ts.table("http_events")
+    # window 0 rows arrive over two polls; emitted once, merged across polls
+    t.write({"time_": np.array([1, 2]), "service": ["a", "b"], "latency": [1.0, 1.0]})
+    assert sq.poll() == {}
+    t.write({"time_": np.array([3]), "service": ["a"], "latency": [1.0]})
+    assert sq.poll() == {}
+    t.write({"time_": np.array([1 * SEC + 1]), "service": ["c"], "latency": [1.0]})
+    got = sq.poll()["out"].to_pandas()
+    assert got["cnt"].sum() == 3 and len(got) == 1  # grouped by window only
+    fin = sq.close()["out"].to_pandas()
+    assert list(fin["cnt"]) == [1]
+
+
+def test_nonwindowed_stream_agg_emits_at_close():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.groupby('service').agg(cnt=('latency', px.count), m=('latency', px.mean))
+px.display(df, 'out')
+""",
+        ts,
+    )
+    _write(ts, 0, 10, svc="x", lat=2.0)
+    assert sq.poll() == {}
+    _write(ts, 10, 5, svc="y", lat=4.0)
+    assert sq.poll() == {}
+    fin = sq.close()["out"].to_pandas().sort_values("service").reset_index(drop=True)
+    assert list(fin["service"]) == ["x", "y"]
+    assert list(fin["cnt"]) == [10, 5]
+    np.testing.assert_allclose(fin["m"], [2.0, 4.0])
+
+
+def test_stream_while_writer_runs_snapshot_consistent():
+    """Continuous writer + polling reader: every row is seen exactly once."""
+    ts = _store(batch_rows=256)
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+px.display(df, 'out')
+""",
+        ts,
+    )
+    stop = threading.Event()
+    written = [0]
+
+    def writer():
+        t0 = 0
+        while not stop.is_set():
+            _write(ts, t0, 500)
+            written[0] += 500
+            t0 += 500
+
+    th = threading.Thread(target=writer)
+    th.start()
+    seen = 0
+    for _ in range(20):
+        got = sq.poll()
+        if got:
+            seen += got["out"].num_rows
+    stop.set()
+    th.join()
+    got = sq.poll()
+    if got:
+        seen += got["out"].num_rows
+    assert seen == written[0], f"saw {seen} of {written[0]} rows"
+
+
+def test_post_agg_filter_applies_to_emissions():
+    ts = _store()
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.rolling('1s').agg(cnt=('latency', px.count))
+df = df[df.cnt > 2]
+px.display(df, 'out')
+""",
+        ts,
+    )
+    t = ts.table("http_events")
+    t.write({"time_": np.array([0, 1, 2, 1 * SEC + 1, 2 * SEC + 1]),
+             "service": ["a"] * 5, "latency": [1.0] * 5})
+    got = sq.poll()["out"].to_pandas()  # [0,1s): cnt=3 passes; [1s,2s): cnt=1 filtered
+    assert list(got["time_"]) == [0]
+    assert list(got["cnt"]) == [3]
+    assert sq.close() == {}  # open window [2s,3s) has cnt=1, filtered
