@@ -14,8 +14,6 @@ drives the in-mesh psum path (pixie_tpu.parallel.spmd).
 from __future__ import annotations
 
 import dataclasses
-import io
-import pickle
 
 import numpy as np
 
@@ -48,31 +46,22 @@ class PartialAggBatch:
             return len(leaves[0]) if leaves else 0
         return 0
 
-    # Wire format (the TransferResultChunk analog for state channels): a
-    # restricted pickle of plain numpy/str/int structures.
+    # Wire format (the TransferResultChunk analog for state channels): the
+    # services.wire binary frame — self-describing header + raw buffers, no
+    # pickle (untrusted bytes never reach an unpickler).
     def to_bytes(self) -> bytes:
-        buf = io.BytesIO()
-        pickle.dump(
-            {
-                "key_cols": self.key_cols,
-                "key_dtypes": {k: int(v) for k, v in self.key_dtypes.items()},
-                "states": self.states,
-                "in_types": {k: (int(v) if v is not None else None) for k, v in self.in_types.items()},
-            },
-            buf,
-            protocol=4,
-        )
-        return buf.getvalue()
+        from pixie_tpu.services.wire import encode_partial_agg
+
+        return encode_partial_agg(self)
 
     @staticmethod
     def from_bytes(b: bytes) -> "PartialAggBatch":
-        d = pickle.loads(b)
-        return PartialAggBatch(
-            key_cols=d["key_cols"],
-            key_dtypes={k: DT(v) for k, v in d["key_dtypes"].items()},
-            states=d["states"],
-            in_types={k: (DT(v) if v is not None else None) for k, v in d["in_types"].items()},
-        )
+        from pixie_tpu.services.wire import decode_frame
+
+        kind, pb = decode_frame(b)
+        if kind != "partial_agg":
+            raise InvalidArgument(f"expected partial_agg frame, got {kind}")
+        return pb
 
 
 def _leaves(tree):

@@ -1,0 +1,21 @@
+"""Service layer: broker, agents, registry, durable control store, wire format.
+
+The networked counterpart of parallel.cluster.LocalCluster (reference
+src/vizier/services/): query_broker (server.go:307 ExecuteScript), metadata
+agent registry (agent.go:81-150), NATS/gRPC transports.  Control AND data ride
+one framed-TCP transport here; the data plane payloads use a versioned binary
+wire format (no pickle — untrusted bytes never reach an unpickler).
+"""
+from pixie_tpu.services.wire import (
+    decode_frame,
+    encode_host_batch,
+    encode_json,
+    encode_partial_agg,
+)
+
+__all__ = [
+    "decode_frame",
+    "encode_host_batch",
+    "encode_json",
+    "encode_partial_agg",
+]

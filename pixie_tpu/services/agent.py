@@ -1,0 +1,179 @@
+"""Agent runtime: a PEM analog — local TableStore (+ collectors) that dials
+the broker, registers its schemas, heartbeats, and executes plan fragments.
+
+Reference: src/vizier/services/agent/ Manager (registration handshake +
+heartbeats every 5s, manager/manager.h:100-266, heartbeat.h:79) and
+ExecuteQueryMessageHandler running plans on a threadpool (manager/exec.cc:38-98).
+PEM wiring of collector→store mirrors pem/pem_manager.cc:47.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from pixie_tpu.engine.executor import HostBatch, PlanExecutor
+from pixie_tpu.parallel.partial import PartialAggBatch
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.services import wire
+from pixie_tpu.services.transport import Connection, dial
+from pixie_tpu.table.table import TableStore
+
+DEFAULT_HEARTBEAT_S = 5.0  # reference manager/heartbeat.h:79
+
+
+class Agent:
+    def __init__(
+        self,
+        name: str,
+        broker_host: str,
+        broker_port: int,
+        store: Optional[TableStore] = None,
+        collector=None,
+        registry=None,
+        heartbeat_s: float = DEFAULT_HEARTBEAT_S,
+        n_devices: Optional[int] = None,
+    ):
+        self.name = name
+        self.broker = (broker_host, broker_port)
+        self.store = store or (collector.store if collector else TableStore())
+        self.collector = collector
+        self.registry = registry
+        self.heartbeat_s = heartbeat_s
+        self.n_devices = n_devices
+        self.conn: Optional[Connection] = None
+        self.asid: Optional[int] = None
+        self._registered = threading.Event()
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- lifecycle
+    def start(self, timeout: float = 10.0) -> "Agent":
+        if self.collector is not None:
+            self.collector.start()
+        self.conn = dial(*self.broker, on_frame=self._on_frame)
+        self._register()
+        if not self._registered.wait(timeout=timeout):
+            raise TimeoutError(f"agent {self.name}: broker did not ack registration")
+        self._hb_thread = threading.Thread(
+            target=self._hb_loop, daemon=True, name=f"pixie-agent-hb-{self.name}"
+        )
+        self._hb_thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self.collector is not None:
+            self.collector.stop()
+        if self.conn is not None:
+            self.conn.close()
+
+    def _register(self):
+        self.conn.send(wire.encode_json({
+            "msg": "register",
+            "agent": self.name,
+            "schemas": {t: r.to_dict() for t, r in self.store.schemas().items()},
+            "n_devices": self.n_devices,
+        }))
+
+    def _hb_loop(self):
+        while not self._stop.wait(timeout=self.heartbeat_s):
+            if self.conn is None or self.conn.closed:
+                return
+            self.conn.send(wire.encode_json({"msg": "heartbeat", "agent": self.name}))
+
+    # ------------------------------------------------------------------- frames
+    def _on_frame(self, conn: Connection, frame: bytes):
+        kind, payload = wire.decode_frame(frame)
+        if kind != "json":
+            return
+        msg = payload.get("msg")
+        if msg == "registered":
+            self.asid = payload.get("asid")
+            self._registered.set()
+        elif msg == "reregister":
+            self._register()
+        elif msg == "execute":
+            threading.Thread(
+                target=self._execute, args=(payload,), daemon=True,
+                name=f"pixie-agent-exec-{self.name}",
+            ).start()
+
+    def _execute(self, meta: dict):
+        req_id = meta.get("req_id", "")
+        try:
+            plan = Plan.from_dict(meta["plan"])
+            ex = PlanExecutor(
+                plan, self.store, self.registry,
+                analyze=bool(meta.get("analyze", False)),
+            )
+            t0 = time.perf_counter()
+            out = ex.run_agent()
+            for channel, payload in out.items():
+                extra = {"msg": "chunk", "req_id": req_id, "channel": channel,
+                         "agent": self.name}
+                if isinstance(payload, PartialAggBatch):
+                    self.conn.send(wire.encode_partial_agg(payload, extra))
+                elif isinstance(payload, HostBatch):
+                    self.conn.send(wire.encode_host_batch(payload, extra))
+                else:
+                    raise TypeError(f"unexpected payload {type(payload)}")
+            stats = dict(ex.stats)
+            stats["exec_s"] = time.perf_counter() - t0
+            from pixie_tpu.services.broker import _jsonable
+
+            self.conn.send(wire.encode_json({
+                "msg": "exec_done", "req_id": req_id, "agent": self.name,
+                "stats": _jsonable(stats),
+            }))
+        except Exception as e:
+            self.conn.send(wire.encode_json({
+                "msg": "exec_error", "req_id": req_id, "agent": self.name,
+                "error": str(e),
+            }))
+
+
+def main(argv=None):
+    """`python -m pixie_tpu.services.agent --name pem1 --broker host:port
+    [--connector seq_gen]` — standalone agent process (the pem_main analog)."""
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--name", required=True)
+    ap.add_argument("--broker", required=True, help="host:port")
+    ap.add_argument("--connector", action="append", default=[],
+                    help="seq_gen | proc_stats (repeatable)")
+    ap.add_argument("--heartbeat-s", type=float, default=DEFAULT_HEARTBEAT_S)
+    args = ap.parse_args(argv)
+    host, port = args.broker.rsplit(":", 1)
+
+    from pixie_tpu.collect.core import Collector
+
+    collector = Collector()
+    for cname in args.connector:
+        if cname == "seq_gen":
+            from pixie_tpu.collect.seq_gen import SeqGenConnector
+
+            collector.register(SeqGenConnector())
+        elif cname == "proc_stats":
+            from pixie_tpu.collect.proc_stats import ProcStatsConnector
+
+            collector.register(ProcStatsConnector())
+        else:
+            raise SystemExit(f"unknown connector {cname!r}")
+    agent = Agent(args.name, host, int(port), collector=collector,
+                  heartbeat_s=args.heartbeat_s)
+    agent.start()
+    try:
+        while True:
+            time.sleep(1.0)
+            if agent.conn is None or agent.conn.closed:
+                raise SystemExit("broker connection lost")
+    except KeyboardInterrupt:
+        pass
+    finally:
+        agent.stop()
+
+
+if __name__ == "__main__":
+    main()

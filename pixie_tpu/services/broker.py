@@ -1,0 +1,299 @@
+"""Query broker: the networked ExecuteScript front door.
+
+Reference: src/vizier/services/query_broker — Server.ExecuteScript
+(controllers/server.go:307) compiles the script, LaunchQuery ships per-agent
+plans (launch_query.go:36-66), and QueryResultForwarder merges agent result
+streams into the client stream with producer/consumer watchdogs
+(query_result_forwarder.go:358-560).
+
+This broker listens on one framed-TCP port for BOTH agents and clients
+(the envelope's `msg` field routes).  Per query: compile against the live
+registry's schemas, split with DistributedPlanner, push `execute` frames to
+each agent's connection, collect channel payload frames, merge (partials via
+combine/finalize, rows via dictionary-reconciled union), run the merger plan
+locally, and stream result chunks back to the client.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Optional
+
+from pixie_tpu.engine.executor import HostBatch, PlanExecutor
+from pixie_tpu.engine.result import QueryResult
+from pixie_tpu.parallel.distributed import DistributedPlanner
+from pixie_tpu.parallel.partial import PartialAggBatch, merge_partials
+from pixie_tpu.services import wire
+from pixie_tpu.services.kvstore import KVStore
+from pixie_tpu.services.registry import AgentRegistry
+from pixie_tpu.services.transport import Connection, Server
+from pixie_tpu.status import PxError
+from pixie_tpu.table.table import TableStore
+from pixie_tpu.types import Relation
+
+DEFAULT_QUERY_TIMEOUT_S = 60.0
+
+
+class _QueryCtx:
+    def __init__(self, expected_agents: set[str], channels: set[str]):
+        self.payloads: dict[str, list] = {c: [] for c in channels}
+        self.pending_agents = set(expected_agents)
+        self.agent_stats: dict[str, dict] = {}
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+
+class Broker:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        datastore_path: str = ":memory:",
+        hb_expiry_s: float = 15.0,
+        registry=None,
+        query_timeout_s: float = DEFAULT_QUERY_TIMEOUT_S,
+    ):
+        self.kv = KVStore(datastore_path)
+        self.registry = AgentRegistry(self.kv, expiry_s=hb_expiry_s)
+        self.udf_registry = registry
+        self.query_timeout_s = query_timeout_s
+        self.merger_store = TableStore()
+        self._server = Server(host, port, self._on_frame, self._on_close)
+        self._agent_conns: dict[str, Connection] = {}
+        self._queries: dict[str, _QueryCtx] = {}
+        self._qlock = threading.Lock()
+        self._req_counter = 0
+        self._expiry_thread = threading.Thread(
+            target=self._expiry_loop, daemon=True, name="pixie-broker-expiry"
+        )
+        self._stopped = threading.Event()
+
+    # ------------------------------------------------------------------ server
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> "Broker":
+        self._server.start()
+        self._expiry_thread.start()
+        return self
+
+    def stop(self):
+        self._stopped.set()
+        self._server.stop()
+        self.kv.close()
+
+    def _expiry_loop(self):
+        while not self._stopped.wait(timeout=max(self.registry.expiry_s / 3, 0.2)):
+            self.registry.expire()
+            # Reconcile connections against registry liveness — no matter
+            # WHICH thread's expire() marked an agent dead (query paths call
+            # live_agents() too), its connection gets closed here.  Dead
+            # agents can't be revived by heartbeats (registry.heartbeat), so
+            # this doesn't race a revival.
+            live = {r.name for r in self.registry.live_agents()}
+            for name, conn in list(self._agent_conns.items()):
+                if name not in live:
+                    self._agent_conns.pop(name, None)
+                    conn.close()
+
+    # ------------------------------------------------------------------ frames
+    def _on_frame(self, conn: Connection, frame: bytes):
+        kind, payload = wire.decode_frame(frame)
+        if kind == "json":
+            msg = payload.get("msg")
+            if msg == "register":
+                self._handle_register(conn, payload)
+            elif msg == "heartbeat":
+                if not self.registry.heartbeat(payload["agent"]):
+                    conn.send(wire.encode_json({"msg": "reregister"}))
+            elif msg == "exec_done":
+                self._handle_exec_done(payload)
+            elif msg == "exec_error":
+                self._handle_exec_error(payload)
+            elif msg == "execute_script":
+                threading.Thread(
+                    target=self._run_query, args=(conn, payload), daemon=True
+                ).start()
+            elif msg == "list_schemas":
+                conn.send(wire.encode_json({
+                    "msg": "schemas",
+                    "req_id": payload.get("req_id"),
+                    "schemas": {
+                        t: r.to_dict()
+                        for t, r in self.registry.combined_schemas().items()
+                    },
+                }))
+            else:
+                conn.send(wire.encode_json({"msg": "error", "error": f"unknown msg {msg!r}"}))
+        else:
+            # data chunk from an agent (host_batch | partial_agg)
+            meta = payload.wire_meta
+            self._handle_chunk(meta, payload)
+
+    def _on_close(self, conn: Connection):
+        name = conn.state.get("agent")
+        if name is not None:
+            self.registry.mark_dead(name)
+            self._agent_conns.pop(name, None)
+            # fail this agent's pending queries (producer watchdog analog)
+            with self._qlock:
+                for ctx in self._queries.values():
+                    if name in ctx.pending_agents:
+                        ctx.error = f"agent {name} disconnected mid-query"
+                        ctx.done.set()
+
+    # ---------------------------------------------------------------- handlers
+    def _handle_register(self, conn: Connection, meta: dict):
+        name = meta["agent"]
+        schemas = {t: Relation.from_dict(r) for t, r in meta["schemas"].items()}
+        asid = self.registry.register(name, schemas, meta.get("n_devices"))
+        conn.state["agent"] = name
+        old = self._agent_conns.get(name)
+        if old is not None and old is not conn:
+            old.state.pop("agent", None)  # superseded; don't let its close kill the new one
+            old.close()
+        self._agent_conns[name] = conn
+        conn.send(wire.encode_json({"msg": "registered", "asid": asid}))
+
+    def _ctx(self, req_id: str) -> Optional[_QueryCtx]:
+        with self._qlock:
+            return self._queries.get(req_id)
+
+    def _handle_chunk(self, meta: dict, payload):
+        ctx = self._ctx(meta.get("req_id", ""))
+        if ctx is None:
+            return
+        ctx.payloads.setdefault(meta["channel"], []).append(payload)
+
+    def _handle_exec_done(self, meta: dict):
+        ctx = self._ctx(meta.get("req_id", ""))
+        if ctx is None:
+            return
+        ctx.agent_stats[meta["agent"]] = meta.get("stats", {})
+        ctx.pending_agents.discard(meta["agent"])
+        if not ctx.pending_agents:
+            ctx.done.set()
+
+    def _handle_exec_error(self, meta: dict):
+        ctx = self._ctx(meta.get("req_id", ""))
+        if ctx is None:
+            return
+        ctx.error = f"agent {meta.get('agent')}: {meta.get('error')}"
+        ctx.done.set()
+
+    # ------------------------------------------------------------------- query
+    def _run_query(self, client: Connection, meta: dict):
+        req_id = meta.get("req_id", "")
+        try:
+            results, stats = self.execute_script(
+                meta["script"],
+                func=meta.get("func"),
+                func_args=meta.get("func_args"),
+                now=meta.get("now"),
+                default_limit=meta.get("default_limit"),
+                analyze=bool(meta.get("analyze", False)),
+            )
+            for name, qr in results.items():
+                hb = HostBatch(
+                    dtypes={n: qr.relation.dtype(n) for n in qr.relation.names()},
+                    dicts=qr.dictionaries,
+                    cols=qr.columns,
+                )
+                client.send(wire.encode_host_batch(
+                    hb, {"msg": "result_chunk", "req_id": req_id, "table": name}
+                ))
+            client.send(wire.encode_json(
+                {"msg": "done", "req_id": req_id, "stats": _jsonable(stats)}
+            ))
+        except Exception as e:  # compile/plan/exec errors all surface to client
+            if not isinstance(e, PxError):
+                traceback.print_exc()
+            client.send(wire.encode_json(
+                {"msg": "error", "req_id": req_id, "error": str(e)}
+            ))
+
+    def execute_script(
+        self, script: str, func=None, func_args=None, now=None,
+        default_limit=None, analyze: bool = False,
+    ) -> tuple[dict[str, QueryResult], dict]:
+        """Compile + distribute + merge (the in-process core of ExecuteScript)."""
+        from pixie_tpu.compiler import compile_pxl
+        from pixie_tpu.parallel.cluster import _union_host_batches
+        from pixie_tpu.status import Internal, Unavailable
+
+        spec = self.registry.cluster_spec()
+        if not any(a.has_data_store for a in spec.agents):
+            raise Unavailable("no live data agents registered")
+        q = compile_pxl(
+            script, self.registry.combined_schemas(), func=func,
+            func_args=func_args, registry=self.udf_registry, now=now,
+            default_limit=default_limit,
+        )
+        dp = DistributedPlanner(spec).plan(q.plan)
+
+        with self._qlock:
+            self._req_counter += 1
+            req_id = f"q{self._req_counter}"
+            ctx = _QueryCtx(set(dp.agent_plans), set(dp.channels))
+            self._queries[req_id] = ctx
+        try:
+            for agent_name, plan in dp.agent_plans.items():
+                conn = self._agent_conns.get(agent_name)
+                if conn is None or conn.closed:
+                    raise Unavailable(f"agent {agent_name} not connected")
+                conn.send(wire.encode_json({
+                    "msg": "execute", "req_id": req_id,
+                    "plan": plan.to_dict(), "analyze": analyze,
+                }))
+            if dp.agent_plans and not ctx.done.wait(timeout=self.query_timeout_s):
+                raise Unavailable(
+                    f"query timed out after {self.query_timeout_s}s waiting for "
+                    f"agents {sorted(ctx.pending_agents)}"
+                )
+            if ctx.error:
+                raise Unavailable(ctx.error)
+
+            reg = self.udf_registry
+            if reg is None:
+                from pixie_tpu.udf import registry as reg
+            inputs: dict[str, HostBatch] = {}
+            for cid, ch in dp.channels.items():
+                got = ctx.payloads.get(cid, [])
+                if not got:
+                    raise Internal(f"channel {cid} received no payloads")
+                if ch.kind == "agg_state":
+                    if not all(isinstance(p, PartialAggBatch) for p in got):
+                        raise Internal(f"channel {cid}: expected agg_state payloads")
+                    inputs[cid] = merge_partials(ch.agg, got, reg)
+                else:
+                    if not all(isinstance(p, HostBatch) for p in got):
+                        raise Internal(f"channel {cid}: expected row payloads")
+                    inputs[cid] = _union_host_batches(got)
+
+            ex = PlanExecutor(
+                dp.merger_plan, self.merger_store, self.udf_registry,
+                inputs=inputs, analyze=analyze,
+            )
+            results = ex.run()
+            stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+            for r in results.values():
+                r.exec_stats["agents"] = ctx.agent_stats
+            return results, stats
+        finally:
+            with self._qlock:
+                self._queries.pop(req_id, None)
+
+
+def _jsonable(obj):
+    import numpy as np
+
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return obj

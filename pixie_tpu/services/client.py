@@ -1,0 +1,125 @@
+"""Python client for the broker's ExecuteScript API.
+
+Reference: src/api/python/pxapi/client.py:100-262 (Conn/ScriptExecutor) — a
+streaming client that connects, runs a script, and receives per-table row
+batches + exec stats.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from pixie_tpu.engine.result import QueryResult
+from pixie_tpu.services import wire
+from pixie_tpu.services.transport import Connection, dial
+from pixie_tpu.status import PxError, Unavailable
+from pixie_tpu.types import ColumnSchema, Relation
+
+
+class QueryError(PxError):
+    pass
+
+
+class _Pending:
+    def __init__(self):
+        self.chunks: list = []
+        self.stats: dict = {}
+        self.schemas: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.done = threading.Event()
+
+
+class Client:
+    """Blocking client (the pxapi Conn analog)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 120.0):
+        self.timeout_s = timeout_s
+        self._pending: dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+        self._req = 0
+        self.conn: Connection = dial(host, port, on_frame=self._on_frame,
+                                     on_close=self._on_close)
+
+    def close(self):
+        self.conn.close()
+
+    # ------------------------------------------------------------------ frames
+    def _on_frame(self, conn: Connection, frame: bytes):
+        kind, payload = wire.decode_frame(frame)
+        meta = payload if kind == "json" else payload.wire_meta
+        p = self._pending.get(meta.get("req_id", ""))
+        if p is None:
+            return
+        msg = meta.get("msg")
+        if kind == "host_batch" and msg == "result_chunk":
+            p.chunks.append((meta["table"], payload))
+        elif msg == "done":
+            p.stats = meta.get("stats", {})
+            p.done.set()
+        elif msg == "schemas":
+            p.schemas = meta["schemas"]
+            p.done.set()
+        elif msg == "error":
+            p.error = meta.get("error", "unknown error")
+            p.done.set()
+
+    def _on_close(self, conn: Connection):
+        with self._lock:
+            for p in self._pending.values():
+                if not p.done.is_set():
+                    p.error = "connection to broker lost"
+                    p.done.set()
+
+    def _new_pending(self) -> tuple[str, _Pending]:
+        with self._lock:
+            self._req += 1
+            rid = f"c{self._req}"
+            p = _Pending()
+            self._pending[rid] = p
+            return rid, p
+
+    # --------------------------------------------------------------------- api
+    def execute_script(
+        self, script: str, func=None, func_args=None, now=None,
+        default_limit=None, analyze: bool = False,
+    ) -> dict[str, QueryResult]:
+        rid, p = self._new_pending()
+        try:
+            ok = self.conn.send(wire.encode_json({
+                "msg": "execute_script", "req_id": rid, "script": script,
+                "func": func, "func_args": func_args, "now": now,
+                "default_limit": default_limit, "analyze": analyze,
+            }))
+            if not ok:
+                raise Unavailable("broker connection closed")
+            if not p.done.wait(timeout=self.timeout_s):
+                raise Unavailable(f"query timed out after {self.timeout_s}s")
+            if p.error:
+                raise QueryError(p.error)
+            out: dict[str, QueryResult] = {}
+            for table, hb in p.chunks:
+                rel = Relation([ColumnSchema(n, hb.dtypes[n]) for n in hb.cols])
+                out[table] = QueryResult(
+                    name=table, relation=rel, columns=hb.cols,
+                    dictionaries=hb.dicts, exec_stats=dict(p.stats),
+                )
+            return out
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+    def schemas(self) -> dict[str, Relation]:
+        rid, p = self._new_pending()
+        try:
+            if not self.conn.send(
+                wire.encode_json({"msg": "list_schemas", "req_id": rid})
+            ):
+                raise Unavailable("broker connection closed")
+            if not p.done.wait(timeout=self.timeout_s):
+                raise Unavailable("schema request timed out")
+            if p.error:
+                raise QueryError(p.error)
+            return {t: Relation.from_dict(r) for t, r in (p.schemas or {}).items()}
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)

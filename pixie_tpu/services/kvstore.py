@@ -1,0 +1,68 @@
+"""Durable control-state store.
+
+The reference persists agent registry / schemas / tracepoints / cron scripts in
+an embedded KV store (pebbledb default; src/vizier/utils/datastore/) — telemetry
+data itself is deliberately NOT durable (SURVEY.md §5 checkpoint/resume).  This
+is the same split: a small sqlite3-backed KV for control state only.
+"""
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+
+class KVStore:
+    """Tiny durable KV (namespace via key prefixes, like the reference's
+    datastore `SetWithPrefix/GetWithPrefix`)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.path = path
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v BLOB)"
+            )
+            self._conn.commit()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                (key, value),
+            )
+            self._conn.commit()
+
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+        return None if row is None else bytes(row[0])
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k=?", (key,))
+            self._conn.commit()
+
+    def scan(self, prefix: str) -> Iterator[tuple[str, bytes]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                (prefix, prefix + "￿"),
+            ).fetchall()
+        for k, v in rows:
+            yield k, bytes(v)
+
+    # JSON conveniences (control state is JSON-safe by construction)
+    def set_json(self, key: str, value) -> None:
+        self.set(key, json.dumps(value).encode())
+
+    def get_json(self, key: str, default=None):
+        raw = self.get(key)
+        return default if raw is None else json.loads(raw.decode())
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

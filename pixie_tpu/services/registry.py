@@ -1,0 +1,147 @@
+"""Agent registry with heartbeat expiry, persisted in the control KV store.
+
+Reference: the metadata service's agent manager — register/heartbeat agents,
+expire them when heartbeats stop, drop their schemas from planning
+(src/vizier/services/metadata/controllers/agent/agent.go:81-150,221-470).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
+from pixie_tpu.services.kvstore import KVStore
+from pixie_tpu.types import Relation
+
+
+@dataclasses.dataclass
+class AgentRecord:
+    name: str
+    asid: int
+    schemas: dict  # table -> Relation
+    n_devices: Optional[int]
+    last_heartbeat: float
+    alive: bool = True
+
+
+class AgentRegistry:
+    """Live agent set + durable record (the registry survives broker restarts;
+    liveness does not — agents must re-register/heartbeat)."""
+
+    def __init__(self, kv: Optional[KVStore] = None, expiry_s: float = 15.0):
+        self.kv = kv or KVStore()
+        self.expiry_s = expiry_s
+        self._agents: dict[str, AgentRecord] = {}
+        self._next_asid = 1
+        self._lock = threading.Lock()
+        # Recall durable records (dead until they heartbeat again).
+        for key, raw in self.kv.scan("agent/"):
+            import json
+
+            d = json.loads(raw.decode())
+            rec = AgentRecord(
+                name=d["name"],
+                asid=d["asid"],
+                schemas={t: Relation.from_dict(r) for t, r in d["schemas"].items()},
+                n_devices=d.get("n_devices"),
+                last_heartbeat=0.0,
+                alive=False,
+            )
+            self._agents[rec.name] = rec
+            self._next_asid = max(self._next_asid, rec.asid + 1)
+
+    # ---------------------------------------------------------------- mutation
+    def register(self, name: str, schemas: dict, n_devices: Optional[int] = None) -> int:
+        """(Re-)register an agent; returns its ASID."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._agents.get(name)
+            if rec is None:
+                rec = AgentRecord(name, self._next_asid, schemas, n_devices, now)
+                self._next_asid += 1
+                self._agents[name] = rec
+            else:
+                rec.schemas = schemas
+                rec.n_devices = n_devices
+                rec.last_heartbeat = now
+                rec.alive = True
+            self.kv.set_json(
+                f"agent/{name}",
+                {
+                    "name": name,
+                    "asid": rec.asid,
+                    "schemas": {t: r.to_dict() for t, r in schemas.items()},
+                    "n_devices": n_devices,
+                },
+            )
+            return rec.asid
+
+    def heartbeat(self, name: str) -> bool:
+        with self._lock:
+            rec = self._agents.get(name)
+            if rec is None or not rec.alive:
+                # Unknown OR already expired: a heartbeat cannot revive a dead
+                # agent — it must re-register (reference agent.go: expired
+                # agents are deleted and handshake anew).  This also closes
+                # the expire/heartbeat race: once dead, stays dead until
+                # register().
+                return False
+            rec.last_heartbeat = time.monotonic()
+            return True
+
+    def mark_dead(self, name: str) -> None:
+        with self._lock:
+            rec = self._agents.get(name)
+            if rec is not None:
+                rec.alive = False
+
+    def expire(self) -> list[str]:
+        """Mark agents whose heartbeats lapsed as dead; returns newly-dead."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            for rec in self._agents.values():
+                if rec.alive and now - rec.last_heartbeat > self.expiry_s:
+                    rec.alive = False
+                    out.append(rec.name)
+        return out
+
+    # ------------------------------------------------------------------- views
+    def live_agents(self) -> list[AgentRecord]:
+        self.expire()
+        with self._lock:
+            return [r for r in self._agents.values() if r.alive]
+
+    def cluster_spec(self, merger_name: str = "broker") -> ClusterSpec:
+        """Planner topology over LIVE agents only (dead agents are planned
+        around — reference: expired agents drop out of DistributedState)."""
+        agents = [
+            AgentInfo(
+                name=r.name,
+                has_data_store=True,
+                processes_data=True,
+                accepts_remote_sources=False,
+                schemas=r.schemas,
+                n_devices=r.n_devices,
+            )
+            for r in self.live_agents()
+        ]
+        agents.append(
+            AgentInfo(
+                name=merger_name,
+                has_data_store=False,
+                processes_data=False,
+                accepts_remote_sources=True,
+                schemas={},
+            )
+        )
+        return ClusterSpec(agents)
+
+    def combined_schemas(self) -> dict[str, Relation]:
+        out: dict[str, Relation] = {}
+        for r in self.live_agents():
+            for t, rel in r.schemas.items():
+                out.setdefault(t, rel)
+        return out

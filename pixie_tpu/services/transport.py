@@ -1,0 +1,186 @@
+"""Length-prefixed framed messaging over TCP sockets.
+
+The reference runs a dual fabric — NATS for control, gRPC streaming for data
+(SURVEY.md §5).  Here both ride one framed-TCP transport: each message is
+`u32 length | wire frame` (services.wire), and a lightweight envelope in the
+frame's JSON meta carries routing (`msg`, `req_id`).  Connections are
+full-duplex: either side sends at any time; a reader thread per connection
+dispatches by handler.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+from pixie_tpu.status import Internal
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+def send_frame(sock: socket.socket, frame: bytes) -> None:
+    if len(frame) > MAX_FRAME:
+        raise Internal(f"frame too large ({len(frame)} bytes)")
+    sock.sendall(_LEN.pack(len(frame)) + frame)
+
+
+def recv_frame(sock: socket.socket) -> Optional[bytes]:
+    """One frame, or None on clean EOF."""
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise Internal(f"peer announced oversized frame ({n} bytes)")
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return body
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            b = sock.recv(min(n - got, 1 << 20))
+        except (ConnectionResetError, OSError):
+            return None
+        if not b:
+            return None
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+class Connection:
+    """One framed full-duplex connection with a background reader thread."""
+
+    def __init__(self, sock: socket.socket, on_frame: Callable[["Connection", bytes], None],
+                 on_close: Optional[Callable[["Connection"], None]] = None,
+                 name: str = "?"):
+        self.sock = sock
+        self.name = name
+        self._on_frame = on_frame
+        self._on_close = on_close
+        self._wlock = threading.Lock()
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._read_loop, name=f"pixie-conn-{name}", daemon=True
+        )
+        #: arbitrary per-connection state for the owning service
+        self.state: dict = {}
+
+    def start(self):
+        self._thread.start()
+
+    def _read_loop(self):
+        while True:
+            frame = recv_frame(self.sock)
+            if frame is None:
+                break
+            try:
+                self._on_frame(self, frame)
+            except Exception:
+                # handler bugs must not kill the connection reader
+                import traceback
+
+                traceback.print_exc()
+        self.close()
+
+    def send(self, frame: bytes) -> bool:
+        with self._wlock:
+            try:
+                send_frame(self.sock, frame)
+                return True
+            except OSError:
+                return False
+
+    def close(self):
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self._on_close is not None:
+            cb, self._on_close = self._on_close, None
+            cb(self)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed.is_set()
+
+
+class Server:
+    """Accept-loop TCP server handing Connections to a handler factory."""
+
+    def __init__(self, host: str, port: int,
+                 on_frame: Callable[[Connection, bytes], None],
+                 on_close: Optional[Callable[[Connection], None]] = None):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._on_frame = on_frame
+        self._on_close = on_close
+        self._stop = threading.Event()
+        self._conns: list[Connection] = []
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="pixie-server", daemon=True
+        )
+
+    def start(self) -> "Server":
+        self._thread.start()
+        return self
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+            def on_close(c, _user=self._on_close):
+                try:
+                    self._conns.remove(c)
+                except ValueError:
+                    pass
+                if _user is not None:
+                    _user(c)
+
+            conn = Connection(
+                sock, self._on_frame, on_close, name=f"{addr[0]}:{addr[1]}"
+            )
+            self._conns.append(conn)
+            conn.start()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            c.close()
+
+
+def dial(host: str, port: int,
+         on_frame: Callable[[Connection, bytes], None],
+         on_close: Optional[Callable[[Connection], None]] = None,
+         timeout: float = 10.0) -> Connection:
+    sock = socket.create_connection((host, port), timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn = Connection(sock, on_frame, on_close, name=f"{host}:{port}")
+    conn.start()
+    return conn

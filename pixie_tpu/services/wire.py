@@ -1,0 +1,251 @@
+"""Versioned binary wire format for control + data messages.
+
+Replaces the reference's protobuf RowBatchData / TransferResultChunk
+(src/carnot/carnotpb/carnot.proto:30-96, vizierpb RowBatchData) with a
+self-describing frame:
+
+    MAGIC "PXW1" | u32 header_len | header JSON (utf-8) | buffer bytes...
+
+The header carries the message kind, JSON-safe metadata, and a buffer table
+(name, numpy dtype str, length); numeric column data travels as raw
+little-endian buffers, NEVER as pickled objects — a malicious peer can at
+worst produce wrong values, not code execution (the round-1 advisor flagged
+pickle here; this is the replacement).
+
+Kinds:
+  json         — control messages ({} metadata only)
+  host_batch   — HostBatch: dtypes, dictionaries (JSON value lists), columns
+  partial_agg  — PartialAggBatch: key values + flattened UDA state leaves
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+from pixie_tpu.status import InvalidArgument
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import STORAGE_DTYPE, DataType as DT
+
+MAGIC = b"PXW1"
+_HDR = struct.Struct("<4sI")
+
+#: numpy dtype allowlist for wire buffers (validated on decode).
+_ALLOWED_DTYPES = {
+    "<i4", "<i8", "<u4", "<u8", "<f4", "<f8", "|b1", "<i2", "<u2", "|i1", "|u1"
+}
+
+
+def _norm_dtype(d: np.dtype) -> str:
+    s = np.dtype(d).str
+    if s == "=i8":
+        s = "<i8"
+    return s
+
+
+# ------------------------------------------------------------------- encoding
+
+
+def _frame(kind: str, meta: dict, bufs: list[tuple[str, np.ndarray]]) -> bytes:
+    table = []
+    chunks = []
+    for name, arr in bufs:
+        arr = np.ascontiguousarray(arr)
+        s = _norm_dtype(arr.dtype)
+        if s not in _ALLOWED_DTYPES:
+            raise InvalidArgument(f"wire: dtype {s} of buffer {name!r} not allowed")
+        raw = arr.tobytes()
+        table.append({"name": name, "dtype": s, "shape": list(arr.shape),
+                      "nbytes": len(raw)})
+        chunks.append(raw)
+    header = json.dumps({"kind": kind, "meta": meta, "bufs": table}).encode()
+    return b"".join([_HDR.pack(MAGIC, len(header)), header, *chunks])
+
+
+def encode_json(meta: dict) -> bytes:
+    return _frame("json", meta, [])
+
+
+def _dict_values_jsonable(d: Dictionary, dt: DT) -> list:
+    if dt == DT.UINT128:
+        return [list(v) if v is not None else None for v in d.values()]
+    return d.values()
+
+
+def _dict_values_restore(vals: list, dt: DT) -> list:
+    if dt == DT.UINT128:
+        return [tuple(v) if v is not None else None for v in vals]
+    return vals
+
+
+def encode_host_batch(hb, extra_meta: dict | None = None) -> bytes:
+    """HostBatch → frame (reference: RowBatchData on the result stream)."""
+    meta = {
+        "dtypes": {n: int(t) for n, t in hb.dtypes.items()},
+        "dicts": {
+            n: _dict_values_jsonable(d, hb.dtypes[n]) for n, d in hb.dicts.items()
+        },
+        "order": list(hb.cols),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return _frame("host_batch", meta, [(n, hb.cols[n]) for n in hb.cols])
+
+
+def encode_partial_agg(pb, extra_meta: dict | None = None) -> bytes:
+    """PartialAggBatch → frame (reference: serialized-UDA partial rows,
+    planpb/plan.proto:250-257)."""
+    key_meta = {}
+    bufs: list[tuple[str, np.ndarray]] = []
+    for name, vals in pb.key_cols.items():
+        dt = pb.key_dtypes[name]
+        arr = np.asarray(vals)
+        if arr.dtype == object:
+            if dt == DT.UINT128:
+                key_meta[name] = {
+                    "jsonvals": [list(v) if v is not None else None for v in arr.tolist()]
+                }
+            else:
+                key_meta[name] = {"jsonvals": arr.tolist()}
+        else:
+            key_meta[name] = {"buf": f"k:{name}"}
+            bufs.append((f"k:{name}", arr))
+    states_meta = {}
+    for out_name, tree in pb.states.items():
+        paths = []
+        for path, leaf in _flatten(tree):
+            bname = f"s:{out_name}:{path}"
+            bufs.append((bname, np.asarray(leaf)))
+            paths.append(path)
+        states_meta[out_name] = paths
+    meta = {
+        "key_dtypes": {k: int(v) for k, v in pb.key_dtypes.items()},
+        "in_types": {k: (int(v) if v is not None else None) for k, v in pb.in_types.items()},
+        "keys": key_meta,
+        "states": states_meta,
+        "key_order": list(pb.key_cols),
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+    return _frame("partial_agg", meta, bufs)
+
+
+def _flatten(tree, prefix="") -> list[tuple[str, np.ndarray]]:
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            if not isinstance(k, str) or "/" in k:
+                raise InvalidArgument(f"wire: bad state key {k!r}")
+            p = f"{prefix}/{k}" if prefix else k
+            out.extend(_flatten(tree[k], p))
+        return out
+    return [(prefix, tree)]
+
+
+def _unflatten(paths: dict[str, np.ndarray]):
+    if list(paths) == [""]:
+        return paths[""]
+    root: dict = {}
+    for path, leaf in paths.items():
+        parts = path.split("/")
+        d = root
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = leaf
+    return root
+
+
+# ------------------------------------------------------------------- decoding
+
+
+def decode_frame(data: bytes):
+    """bytes → (kind, payload).
+
+    json        → (kind, meta dict)
+    host_batch  → (kind, HostBatch-with-meta)
+    partial_agg → (kind, PartialAggBatch-with-meta)
+    The original meta dict is attached as `.wire_meta` on decoded objects.
+    """
+    if len(data) < _HDR.size:
+        raise InvalidArgument("wire: truncated frame")
+    magic, hlen = _HDR.unpack_from(data)
+    if magic != MAGIC:
+        raise InvalidArgument(f"wire: bad magic {magic!r}")
+    if _HDR.size + hlen > len(data):
+        raise InvalidArgument("wire: truncated header")
+    header = json.loads(data[_HDR.size : _HDR.size + hlen].decode())
+    kind = header["kind"]
+    meta = header["meta"]
+    bufs: dict[str, np.ndarray] = {}
+    off = _HDR.size + hlen
+    for b in header["bufs"]:
+        s = b["dtype"]
+        if s not in _ALLOWED_DTYPES:
+            raise InvalidArgument(f"wire: dtype {s} not allowed")
+        nb = int(b["nbytes"])
+        if off + nb > len(data):
+            raise InvalidArgument("wire: truncated buffer")
+        arr = np.frombuffer(data[off : off + nb], dtype=np.dtype(s))
+        shape = tuple(int(x) for x in b["shape"])
+        if int(np.prod(shape)) * arr.itemsize != nb:
+            raise InvalidArgument("wire: buffer shape/nbytes mismatch")
+        bufs[b["name"]] = arr.reshape(shape).copy()  # writable, owned
+        off += nb
+
+    if kind == "json":
+        return kind, meta
+    if kind == "host_batch":
+        from pixie_tpu.engine.executor import HostBatch
+
+        dtypes = {n: DT(v) for n, v in meta["dtypes"].items()}
+        dicts = {
+            n: Dictionary(_dict_values_restore(vals, dtypes[n]))
+            for n, vals in meta["dicts"].items()
+        }
+        cols = {}
+        for n in meta["order"]:
+            if n not in bufs:
+                raise InvalidArgument(f"wire: missing column buffer {n!r}")
+            want = STORAGE_DTYPE[dtypes[n]]
+            cols[n] = bufs[n].astype(want, copy=False)
+        hb = HostBatch(dtypes, dicts, cols)
+        hb.wire_meta = meta  # type: ignore[attr-defined]
+        return kind, hb
+    if kind == "partial_agg":
+        from pixie_tpu.parallel.partial import PartialAggBatch
+
+        key_dtypes = {k: DT(v) for k, v in meta["key_dtypes"].items()}
+        key_cols = {}
+        for name in meta["key_order"]:
+            spec = meta["keys"][name]
+            if "jsonvals" in spec:
+                key_cols[name] = np.asarray(
+                    _dict_values_restore(spec["jsonvals"], key_dtypes[name]),
+                    dtype=object,
+                )
+            else:
+                if spec["buf"] not in bufs:
+                    raise InvalidArgument(f"wire: missing key buffer {spec['buf']!r}")
+                key_cols[name] = bufs[spec["buf"]]
+        states = {}
+        for out_name, paths in meta["states"].items():
+            leaves = {}
+            for p in paths:
+                bname = f"s:{out_name}:{p}"
+                if bname not in bufs:
+                    raise InvalidArgument(f"wire: missing state buffer {bname!r}")
+                leaves[p] = bufs[bname]
+            states[out_name] = _unflatten(leaves)
+        pb = PartialAggBatch(
+            key_cols=key_cols,
+            key_dtypes=key_dtypes,
+            states=states,
+            in_types={
+                k: (DT(v) if v is not None else None)
+                for k, v in meta["in_types"].items()
+            },
+        )
+        pb.wire_meta = meta  # type: ignore[attr-defined]
+        return kind, pb
+    raise InvalidArgument(f"wire: unknown kind {kind!r}")

@@ -68,6 +68,12 @@ class Unimplemented(PxError):
     code = Code.UNIMPLEMENTED
 
 
+class Unavailable(PxError):
+    """A required peer (agent/broker) is down or timed out."""
+
+    code = Code.UNAVAILABLE
+
+
 class CompilerError(PxError):
     """PxL compile error with line context (reference: planner ir::CompilerError)."""
 
