@@ -87,6 +87,73 @@ _ALLOWED_PXL_NODES = frozenset(
 )
 
 
+#: underscore attributes that are real PxL API, not traversal (reference
+#: exec-context UDFs px._exec_hostname / px._exec_host_num_cpus used by the
+#: bundled node/perf_flamegraph scripts).  Exact names only — no dunders.
+_ALLOWED_UNDERSCORE_ATTRS = frozenset({"_exec_hostname", "_exec_host_num_cpus"})
+
+
+class _BoolOpRewrite(ast.NodeTransformer):
+    """Rewrite `and`/`or`/`not` into runtime helpers that build column
+    expressions when an operand is a DataFrame Scalar.
+
+    The reference's own front end compiles these operators to logical_and/or/
+    not IR calls (planner ast_visitor); plain Python exec would instead call
+    Scalar.__bool__ and fail.  Python semantics for non-Scalar operands are
+    preserved (incl. short-circuit via thunks).
+    """
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        fn = "__pxl_and__" if isinstance(node.op, ast.And) else "__pxl_or__"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(
+                func=ast.Name(id=fn, ctx=ast.Load()),
+                args=[out, ast.Lambda(
+                    args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                       kw_defaults=[], defaults=[]),
+                    body=v,
+                )],
+                keywords=[],
+            )
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                ast.Call(func=ast.Name(id="__pxl_not__", ctx=ast.Load()),
+                         args=[node.operand], keywords=[]),
+                node,
+            )
+        return node
+
+
+def _pxl_and(a, b_thunk):
+    from pixie_tpu.compiler.pxl import Scalar
+
+    if isinstance(a, Scalar):
+        b = b_thunk()
+        return a & b if isinstance(b, Scalar) else (a if b else False)
+    return a and b_thunk()
+
+
+def _pxl_or(a, b_thunk):
+    from pixie_tpu.compiler.pxl import Scalar
+
+    if isinstance(a, Scalar):
+        b = b_thunk()
+        return a | b if isinstance(b, Scalar) else (True if b else a)
+    return a or b_thunk()
+
+
+def _pxl_not(a):
+    from pixie_tpu.compiler.pxl import Scalar
+
+    return ~a if isinstance(a, Scalar) else (not a)
+
+
 def validate_pxl_source(source: str) -> ast.Module:
     """Parse + validate untrusted PxL text; raises CompilerError on anything
     outside the dialect.  The reference parses PxL in its own front end
@@ -101,7 +168,8 @@ def validate_pxl_source(source: str) -> ast.Module:
         if name not in _ALLOWED_PXL_NODES:
             raise CompilerError(f"PxL does not allow {name} statements")
         if isinstance(node, ast.Attribute) and (
-            node.attr.startswith("_") or node.attr in ("format", "format_map")
+            (node.attr.startswith("_") and node.attr not in _ALLOWED_UNDERSCORE_ATTRS)
+            or node.attr in ("format", "format_map")
         ):
             raise CompilerError(
                 f"PxL does not allow access to attribute {node.attr!r}"
@@ -160,6 +228,10 @@ def compile_pxl(
     # compile() would otherwise leak into the script, stringifying the typed
     # function parameters we coerce below.
     tree = validate_pxl_source(source)
+    tree = ast.fix_missing_locations(_BoolOpRewrite().visit(tree))
+    glb["__pxl_and__"] = _pxl_and
+    glb["__pxl_or__"] = _pxl_or
+    glb["__pxl_not__"] = _pxl_not
     code = compile(tree, "<pxl>", "exec", dont_inherit=True)
     # `import px` resolves through the restricted __import__ hook to THIS
     # compilation's module instance — no sys.modules juggling needed.

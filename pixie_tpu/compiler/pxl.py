@@ -175,18 +175,19 @@ class _MetadataResolver:
         self._df = df
 
     def __getitem__(self, key: str) -> Scalar:
-        spec = CTX_KEYS.get(key)
-        if spec is None:
+        candidates = CTX_KEYS.get(key)
+        if candidates is None:
             raise CompilerError(f"unknown metadata key {key!r}; have {sorted(CTX_KEYS)}")
-        fn, src_col = spec
         df = self._df
-        if src_col not in df._schema:
-            raise CompilerError(
-                f"ctx[{key!r}] needs column {src_col!r} which is not in the DataFrame "
-                f"(have {list(df._schema)})"
-            )
-        out = df._ctx.infer_type(fn, [df._schema[src_col]])
-        return Scalar(Call(fn, (Column(src_col),)), out, df)
+        for fn, src_col in candidates:
+            if src_col in df._schema:
+                out = df._ctx.infer_type(fn, [df._schema[src_col]])
+                return Scalar(Call(fn, (Column(src_col),)), out, df)
+        needed = sorted({c for _fn, c in candidates})
+        raise CompilerError(
+            f"ctx[{key!r}] needs one of columns {needed}, none of which is in "
+            f"the DataFrame (have {list(df._schema)})"
+        )
 
 
 class AggMarker:
@@ -285,6 +286,15 @@ class DataFrame:
 
     def __getitem__(self, key):
         # df[cond] → filter; df['a'] → column; df['a','b'] / df[['a','b']] → projection.
+        if isinstance(key, bool):
+            # A filter condition folded to a plain flag at compile time
+            # (e.g. `df[df.x == 1 and some_module_flag]`): True keeps all
+            # rows (no-op), False keeps none.
+            if key:
+                return self
+            return self._derive(
+                FilterOp(expr=lit(False)), [self._node], self._schema
+            )
         if isinstance(key, Scalar):
             if key.dtype != DT.BOOLEAN:
                 raise CompilerError("df[expr] filter requires a boolean expression")
@@ -466,8 +476,10 @@ class GroupedDataFrame:
 
         values: list[AggExpr] = []
         out_schema: dict[str, DT] = {g: schema_in[g] for g in groups}
-        if not kwargs:
+        if not kwargs and not groups:
             raise CompilerError("agg() requires at least one aggregate")
+        # groupby(...).agg() with no aggregates = DISTINCT over the group keys
+        # (reference objects/dataframe.h: agg with empty kwargs).
         for out_name, spec in kwargs.items():
             if not (isinstance(spec, tuple) and len(spec) == 2):
                 raise CompilerError(

@@ -34,7 +34,13 @@ _AGG_NAMES = (
 class _SemanticStr(str):
     """Semantic-typed script parameter annotation (px.Pod, px.Namespace, ...) —
     physically a string; the semantic type drives UI autocomplete in the
-    reference (vispb), and arg coercion here."""
+    reference (vispb), and arg coercion here.  Calling one on a column
+    expression (px.Node(df.x)) is a semantic CAST: identity on the Scalar."""
+
+    def __new__(cls, v=""):
+        if isinstance(v, Scalar):
+            return v
+        return super().__new__(cls, v)
 
 
 class Namespace(_SemanticStr):
@@ -134,13 +140,14 @@ class PxModule(types.ModuleType):
         return v
 
     # ---------------------------------------------------------------- helpers
-    def select(self, cond, a, b) -> Scalar:
+    def select(self, cond, a, b):
         for v in (cond, a, b):
             if isinstance(v, Scalar):
                 df = v.df
                 break
         else:
-            raise CompilerError("px.select requires at least one column expression")
+            # all-literal select folds at compile time
+            return a if cond else b
         c, av, bv = as_scalar(cond, df), as_scalar(a, df), as_scalar(b, df)
         out = df._ctx.infer_type("select", [c.dtype, av.dtype, bv.dtype])
         return Scalar(Call("select", (c.expr, av.expr, bv.expr)), out, df)
@@ -167,6 +174,22 @@ class PxModule(types.ModuleType):
     def vis(self):  # pragma: no cover - placeholder namespace
         raise CompilerError("px.vis is declarative; use the vis.json spec")
 
+    def normalize_mysql(self, q, cmd=None):
+        """2-arg form (reference sql_ops.cc NormalizeMySQLUDF) takes the int
+        command code column; normalization yields the JSON query-struct.  The
+        command gate is folded: all commands normalize (non-query bodies are
+        unaffected by the literal/number scrubbing)."""
+        if cmd is None:
+            return self.__getattr__("normalize_mysql")(q)
+        return self.__getattr__("normalize_sql_struct")(q)
+
+    def normalize_pgsql(self, q, cmd=None):
+        if cmd is None:
+            return self.__getattr__("normalize_pgsql")(q)
+        if isinstance(cmd, Scalar):
+            return self.__getattr__("normalize_sql_struct")(q)
+        return self.__getattr__("normalize_pgsql")(q, cmd)
+
     # Nullary context helpers (reference metadata_ops.h ASIDUDF etc.)
     def asid(self) -> int:
         from pixie_tpu.metadata import snapshot
@@ -178,10 +201,38 @@ class PxModule(types.ModuleType):
 
         return snapshot().node_name
 
+    # Exec-context UDFs (reference funcs/metadata/metadata_ops.h HostnameUDF /
+    # HostNumCPUsUDF).  DIVERGENCE: the reference evaluates these on each
+    # executing agent; here they fold to the COMPILING node's view (scripts
+    # use them for per-node drilldowns where the value is constant anyway).
+    def _exec_hostname(self) -> str:
+        from pixie_tpu.metadata import snapshot
+
+        return snapshot().node_name or "localhost"
+
+    def _exec_host_num_cpus(self) -> int:
+        import os
+
+        return os.cpu_count() or 1
+
     # ------------------------------------------------------ registry fallback
     def __getattr__(self, name: str):
-        # Fallback: any scalar UDF in the registry becomes px.<name>(...).
+        # Fallback: any scalar UDF in the registry becomes px.<name>(...),
+        # any UDTF becomes px.<Name>(...) returning a DataFrame.
         ctx = object.__getattribute__(self, "_ctx")
+        if ctx.registry.has_udtf(name):
+            from pixie_tpu.plan.plan import UDTFSourceOp
+
+            def call_udtf(_name=name, **kwargs):
+                u = ctx.registry.udtf(_name)
+                op = ctx.plan.add(
+                    UDTFSourceOp(name=_name, args=dict(kwargs),
+                                 schema=u.relation.to_dict())
+                )
+                return DataFrame(ctx, op, {c.name: c.data_type for c in u.relation})
+
+            call_udtf.__name__ = name
+            return call_udtf
         if ctx.registry.has_scalar(name):
             def call(*args, _name=name):
                 df = None
@@ -190,6 +241,20 @@ class PxModule(types.ModuleType):
                         df = a.df
                         break
                 if df is None:
+                    # All-literal call: constant-fold host UDFs at compile
+                    # time (e.g. px.nslookup('10.0.0.1') in a script header).
+                    from pixie_tpu.plan.plan import lit as _lit
+
+                    dts = [_lit(a).dtype for a in args]
+                    o = ctx.registry.scalar(_name, dts)
+                    if not o.device:
+                        # Folds against the CURRENT metadata snapshot — the
+                        # same epoch a column-path LUT of this query would
+                        # bake.  Caveat: a StreamQuery compiles its plan once,
+                        # so volatile folds resolve at stream creation, not
+                        # per poll (batch queries recompile per execution and
+                        # are unaffected).
+                        return o.fn(*args)
                     raise CompilerError(
                         f"px.{_name} requires at least one column expression argument"
                     )

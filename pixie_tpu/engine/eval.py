@@ -48,7 +48,10 @@ class SVal:
 
 
 def apply_lut(lut: jax.Array, codes: jax.Array, fill):
-    """Safe LUT gather: codes may be -1 (null / no-translation) → fill."""
+    """Safe LUT gather: codes may be -1 (null / no-translation) → fill.
+    An EMPTY lut (no dictionary values yet — empty table) yields all-fill."""
+    if lut.shape[0] == 0:
+        return jnp.full(jnp.shape(codes), fill, dtype=jnp.asarray(lut).dtype)
     safe = jnp.clip(codes, 0, lut.shape[0] - 1)
     out = jnp.take(lut, safe)
     return jnp.where(codes >= 0, out, jnp.asarray(fill, dtype=out.dtype))
@@ -191,17 +194,20 @@ class ExprCompiler:
         """
         if udf.int_domain is not None:
             return self._int_domain_call(call, udf)
-        col_idx = None
-        for i, a in enumerate(call.args):
-            if not isinstance(a, Literal):
-                if col_idx is not None:
-                    raise CompilerError(
-                        f"{udf.name}: host UDFs take exactly one column argument "
-                        "(others must be literals)"
-                    )
-                col_idx = i
-        if col_idx is None:
+        non_lit = [i for i, a in enumerate(call.args) if not isinstance(a, Literal)]
+        if len(non_lit) == 2:
+            sa = self.compile(call.args[non_lit[0]])
+            sb = self.compile(call.args[non_lit[1]])
+            if sa.dictionary is not None and sb.dictionary is not None:
+                return self._host_pair_call(call, udf, non_lit, sa, sb)
+        if not non_lit:
             raise CompilerError(f"{udf.name}: needs one column argument")
+        if len(non_lit) != 1:
+            raise CompilerError(
+                f"{udf.name}: host UDFs take one column argument "
+                "(or two dictionary-encoded columns); others must be literals"
+            )
+        col_idx = non_lit[0]
         s = self.compile(call.args[col_idx])
         if s.dictionary is None:
             raise CompilerError(
@@ -233,6 +239,63 @@ class ExprCompiler:
             udf.out_type,
             lambda env, name=name, b=b, fill=fill: apply_lut(env["luts"][name], b(env), fill),
         )
+
+    #: cross-product bound for two-dictionary host calls (compile-time python
+    #: work + LUT bytes; typical script usage is tiny enum×enum / id×id spaces)
+    PAIR_CAP = 1 << 16
+
+    def _host_pair_call(self, call: Call, udf, non_lit, sa: SVal, sb: SVal) -> SVal:
+        """Host UDF over TWO dictionary columns: evaluate over the value
+        cross-product into a flattened 2D LUT indexed by a_code * |b| + b_code.
+        Bounded by PAIR_CAP — O(|a|·|b|) compile work instead of O(rows)."""
+        na, nb = max(sa.dictionary.size, 1), max(sb.dictionary.size, 1)
+        if na * nb > self.PAIR_CAP:
+            raise CompilerError(
+                f"{udf.name}: dictionary cross-product {na}x{nb} exceeds "
+                f"{self.PAIR_CAP}; pre-aggregate or reduce cardinality"
+            )
+        ia, ib = non_lit
+
+        def call_fn(va, vb, fn=udf.fn, args_spec=tuple(call.args)):
+            args = []
+            for i, a in enumerate(args_spec):
+                if i == ia:
+                    args.append(va)
+                elif i == ib:
+                    args.append(vb)
+                else:
+                    args.append(a.value)
+            return fn(*args)
+
+        va_list = sa.dictionary.values()
+        vb_list = sb.dictionary.values()
+        ab, bb = sa.build, sb.build
+        if udf.out_type == DT.STRING:
+            out_dict = Dictionary()
+            lut = np.fromiter(
+                (out_dict.code(call_fn(va, vb)) for va in va_list for vb in vb_list),
+                dtype=np.int32, count=na * nb,
+            ) if va_list and vb_list else np.empty(0, np.int32)
+            fill = -1
+        else:
+            np_out = STORAGE_DTYPE[udf.out_type]
+            lut = np.asarray(
+                [call_fn(va, vb) for va in va_list for vb in vb_list], dtype=np_out
+            )
+            out_dict = None
+            fill = False if udf.out_type == DT.BOOLEAN else 0
+        name = self._add_lut(lut)
+
+        def build(env, name=name, ab=ab, bb=bb, nb=nb, fill=fill):
+            ca, cb = ab(env), bb(env)
+            pair = jnp.where(
+                (ca >= 0) & (cb >= 0),
+                ca.astype(jnp.int32) * nb + cb.astype(jnp.int32),
+                -1,
+            )
+            return apply_lut(env["luts"][name], pair, fill)
+
+        return SVal(udf.out_type, build, out_dict)
 
     def _int_domain_call(self, call: Call, udf) -> SVal:
         lo, hi = udf.int_domain

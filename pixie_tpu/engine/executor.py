@@ -48,6 +48,7 @@ from pixie_tpu.plan.plan import (
     Plan,
     RemoteSourceOp,
     ResultSinkOp,
+    UDTFSourceOp,
     UnionOp,
 )
 from pixie_tpu.status import CompilerError, Internal, Unimplemented
@@ -498,7 +499,7 @@ def _first_len(cols: dict) -> int:
 
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
-                 mesh="auto", analyze: bool = False):
+                 mesh="auto", analyze: bool = False, udtf_ctx=None):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -517,6 +518,9 @@ class PlanExecutor:
         #: synchronizes the device after every feed so per-kernel wall times
         #: measure real execution, not async dispatch.
         self.analyze = analyze
+        #: ambient state for UDTF sources (udf.udtf.UDTFContext); None builds
+        #: a local-view context on demand.
+        self.udtf_ctx = udtf_ctx
         # Device mesh for SPMD aggregation: every unlimited agg shards its
         # feeds over all local devices and merges state with in-program
         # collectives (the reference's per-PEM fan-out + Kelvin merge becomes
@@ -718,6 +722,8 @@ class PlanExecutor:
                 out = self._run_union(op)
             elif isinstance(op, MemorySourceOp):
                 out = self._consume_to_batch(op, [])
+            elif isinstance(op, UDTFSourceOp):
+                out = self._run_udtf(op)
             elif isinstance(op, RemoteSourceOp):
                 got = self.inputs.get(op.channel)
                 if got is None:
@@ -1392,6 +1398,49 @@ class PlanExecutor:
             dtypes[out_name] = out_dt
         return HostBatch(dtypes, dicts, cols)
 
+    # -------------------------------------------------------------------- udtf
+    def _run_udtf(self, op: UDTFSourceOp) -> HostBatch:
+        """Materialize a table-generating function (reference
+        exec/udtf_source_node.*): one columnar batch from a host fn."""
+        from pixie_tpu.types import is_dict_encoded
+        from pixie_tpu.udf.udtf import UDTFContext
+
+        u = self.registry.udtf(op.name)
+        ctx = self.udtf_ctx
+        if ctx is None:
+            from pixie_tpu.metadata import state as _mdstate
+
+            m = _mdstate.global_manager()
+            ctx = UDTFContext(
+                table_store=self.store, registry=self.registry,
+                asid=m.current().asid, node_name=m.current().node_name,
+            )
+        cols_raw = u.fn(ctx, **(op.args or {}))
+        dtypes, dicts, cols = {}, {}, {}
+        for c in u.relation:
+            if c.name not in cols_raw:
+                raise Internal(
+                    f"UDTF {op.name} did not produce declared column {c.name!r}"
+                )
+            vals = list(cols_raw[c.name])
+            dtypes[c.name] = c.data_type
+            if is_dict_encoded(c.data_type):
+                if c.data_type == DT.UINT128:
+                    # tuples would np-broadcast into 2-D object arrays inside
+                    # Dictionary.encode; normalize to UInt128 scalars.
+                    from pixie_tpu.types import UInt128
+
+                    vals = [
+                        UInt128(*v) if isinstance(v, (tuple, list)) else v
+                        for v in vals
+                    ]
+                d = Dictionary()
+                cols[c.name] = d.encode(vals)
+                dicts[c.name] = d
+            else:
+                cols[c.name] = np.asarray(vals, dtype=STORAGE_DTYPE[c.data_type])
+        return HostBatch(dtypes, dicts, cols)
+
     # -------------------------------------------------------------------- join
     def _run_join(self, op: JoinOp) -> HostBatch:
         """Equijoin with full many-to-many expansion, inner/left/right/outer.
@@ -1411,11 +1460,26 @@ class PlanExecutor:
             raise Internal("join needs two parents")
         left = self._materialize_parent(parents[0])
         right = self._materialize_parent(parents[1])
-        if len(op.left_on) != len(op.right_on) or not op.left_on:
-            raise CompilerError("join requires equal, non-empty key lists")
+        if len(op.left_on) != len(op.right_on):
+            raise CompilerError("join requires equal-length key lists")
         if op.how not in ("inner", "left", "right", "outer"):
             raise Unimplemented(f"join how={op.how!r}")
         nl, nr = left.num_rows, right.num_rows
+
+        if not op.left_on:
+            # Empty key lists = cross join (the bundled cluster script uses
+            # merge(left_on=[], right_on=[]) to attach a 1-row time window).
+            # When either side is empty, left/right/outer keep the other
+            # side's rows with null fills (every row is unmatched).
+            lidx = np.repeat(np.arange(nl, dtype=np.int64), nr)
+            ridx = np.tile(np.arange(nr, dtype=np.int64), nl)
+            if nr == 0 and op.how in ("left", "outer"):
+                lidx = np.arange(nl, dtype=np.int64)
+                ridx = np.full(nl, -1, dtype=np.int64)
+            elif nl == 0 and op.how in ("right", "outer"):
+                ridx = np.arange(nr, dtype=np.int64)
+                lidx = np.full(nr, -1, dtype=np.int64)
+            return self._join_output(op, left, right, lidx, ridx)
 
         # Factorize each key pair into a shared integer code space; nulls
         # (dict code -1) are tracked separately and excluded from matching.
@@ -1448,7 +1512,9 @@ class PlanExecutor:
             rsel.append(rum)
         lsel = np.concatenate(lsel)
         rsel = np.concatenate(rsel)
+        return self._join_output(op, left, right, lsel, rsel)
 
+    def _join_output(self, op, left, right, lsel, rsel) -> HostBatch:
         dtypes, dicts, cols = {}, {}, {}
         outputs = op.output or _default_join_output(left, right)
         for side, col, out_name in outputs:

@@ -90,11 +90,49 @@ def register_metadata_funcs(r: Registry) -> None:
     r.register(_host("pod_name_to_start_time", (_S,), DT.TIME64NS,
                      lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)),
                                       "create_time_ns", 0)))
-    r.register(_host("has_service_name", (_S,), DT.BOOLEAN, lambda qn: qn != ""))
-    r.register(_host("has_service_id", (_S,), DT.BOOLEAN, lambda uid: uid != ""))
+    # Aliases + remaining lookups the bundled scripts call
+    # (reference metadata_ops.h PodNameToPodStatusUDF, IPToServiceIDUDF,
+    # ContainerIDToContainerStatusUDF, ServiceIDToClusterIPUDF).
+    r.register(_host("pod_name_to_status", (_S,), _S,
+                     lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)), "phase")))
+    r.register(_host("ip_to_service_id", (_S,), _S,
+                     lambda ip: _attr(mdstate.snapshot().service_of_ip(ip), "uid")))
+    r.register(_host("ip_to_service_name", (_S,), _S,
+                     lambda ip: _qname(mdstate.snapshot().service_of_ip(ip))))
+    r.register(_host("container_id_to_status", (_S,), _S,
+                     lambda cid: _attr(mdstate.snapshot().containers_by_id.get(cid), "state")))
+
+    # has_service_name/has_service_id: 1-arg form tests non-emptiness; the
+    # 2-arg form used by drilldown scripts (px.has_service_name(col, 'ns/svc'))
+    # tests membership, including the reference's grouped "svc1,svc2" encoding.
+    r.register(_host("has_service_name", (_S,), DT.BOOLEAN, lambda qn: qn != "",
+                     volatile=False))
+    r.register(_host("has_service_id", (_S,), DT.BOOLEAN, lambda uid: uid != "",
+                     volatile=False))
+    r.register(_host("has_service_name", (_S, _S), DT.BOOLEAN, _has_value,
+                     volatile=False))
+    r.register(_host("has_service_id", (_S, _S), DT.BOOLEAN, _has_value,
+                     volatile=False))
 
     # Current-context nullary helpers are provided by the compiler (px module)
     # because they need no column input: px.asid(), px.node_name().
+
+
+def _has_value(col_val: str, target: str) -> bool:
+    """Membership test tolerating the reference's multi-value encodings
+    (comma-joined or JSON-list strings of qualified names)."""
+    if not col_val:
+        return False
+    if col_val == target:
+        return True
+    if col_val.startswith("["):
+        import json
+
+        try:
+            return target in json.loads(col_val)
+        except ValueError:
+            return False
+    return target in col_val.split(",")
 
 
 def _qname(obj) -> str:
@@ -139,22 +177,30 @@ register_metadata_funcs(_global_registry)
 #: ctx key → (udf name, required input column). Reference: the analyzer's
 #: metadata-conversion rule rewrites df.ctx['pod'] into upid_to_pod_name(upid)
 #: (planner/compiler/analyzer, metadata resolution).
+#: ctx key → candidate (udf, source column) chain, tried in order against the
+#: DataFrame's columns.  The reference's metadata-conversion rule does the
+#: same: it picks whichever metadata key column the table carries (upid for
+#: traced tables, pod_id for network_stats — metadata_ir.cc ResolveMetadata).
 CTX_KEYS = {
-    "pod": ("upid_to_pod_name", "upid"),
-    "pod_name": ("upid_to_pod_name", "upid"),
-    "pod_id": ("upid_to_pod_id", "upid"),
-    "service": ("upid_to_service_name", "upid"),
-    "service_name": ("upid_to_service_name", "upid"),
-    "service_id": ("upid_to_service_id", "upid"),
-    "namespace": ("upid_to_namespace", "upid"),
-    "node": ("upid_to_node_name", "upid"),
-    "node_name": ("upid_to_node_name", "upid"),
-    "container": ("upid_to_container_name", "upid"),
-    "container_name": ("upid_to_container_name", "upid"),
-    "container_id": ("upid_to_container_id", "upid"),
-    "deployment": ("upid_to_deployment_name", "upid"),
-    "cmdline": ("upid_to_cmdline", "upid"),
-    "cmd": ("upid_to_cmdline", "upid"),
-    "pid": ("upid_to_pid", "upid"),
-    "asid": ("upid_to_asid", "upid"),
+    "pod": [("upid_to_pod_name", "upid"), ("pod_id_to_pod_name", "pod_id")],
+    "pod_name": [("upid_to_pod_name", "upid"), ("pod_id_to_pod_name", "pod_id")],
+    "pod_id": [("upid_to_pod_id", "upid"), ("pod_name_to_pod_id", "pod_name")],
+    "service": [("upid_to_service_name", "upid"),
+                ("pod_id_to_service_name", "pod_id")],
+    "service_name": [("upid_to_service_name", "upid"),
+                     ("pod_id_to_service_name", "pod_id")],
+    "service_id": [("upid_to_service_id", "upid")],
+    "namespace": [("upid_to_namespace", "upid"),
+                  ("pod_id_to_namespace", "pod_id")],
+    "node": [("upid_to_node_name", "upid"), ("pod_id_to_node_name", "pod_id")],
+    "node_name": [("upid_to_node_name", "upid"),
+                  ("pod_id_to_node_name", "pod_id")],
+    "container": [("upid_to_container_name", "upid")],
+    "container_name": [("upid_to_container_name", "upid")],
+    "container_id": [("upid_to_container_id", "upid")],
+    "deployment": [("upid_to_deployment_name", "upid")],
+    "cmdline": [("upid_to_cmdline", "upid")],
+    "cmd": [("upid_to_cmdline", "upid")],
+    "pid": [("upid_to_pid", "upid")],
+    "asid": [("upid_to_asid", "upid")],
 }

@@ -149,8 +149,14 @@ class LocalCluster:
                 inputs[cid] = _union_host_batches(got)
 
         # 3. run the merger plan over the injected channels.
+        from pixie_tpu.udf.udtf import UDTFContext
+
         ex = PlanExecutor(dp.merger_plan, self.merger_store, self.registry,
-                          inputs=inputs, analyze=analyze)
+                          inputs=inputs, analyze=analyze,
+                          udtf_ctx=UDTFContext(
+                              table_store=self.merger_store, registry=reg,
+                              schema_catalog=self.schemas(),
+                          ))
         results = ex.run()
         # Per-agent exec stats ride along with every result (reference:
         # AgentExecutionStats shipped with the final chunk, carnot.cc:227-275).

@@ -42,6 +42,7 @@ from pixie_tpu.plan.plan import (
     Plan,
     RemoteSourceOp,
     ResultSinkOp,
+    UDTFSourceOp,
 )
 from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
 from pixie_tpu.status import CompilerError
@@ -219,6 +220,14 @@ class DistributedPlanner:
                 continue
             parents = logical.parents(op)
             if not parents:
+                if isinstance(op, UDTFSourceOp):
+                    # UDTF sources run merger-side (the reference's ONE_KELVIN
+                    # executor scope, udtf.h UDTFSourceExecutor).
+                    c = copy.copy(op)
+                    c.id = -1
+                    merger_plan.add(c)
+                    lowered[op.id] = c
+                    continue
                 raise CompilerError(
                     f"distributed plan source must be a table scan, got {op.kind}"
                 )

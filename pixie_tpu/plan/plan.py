@@ -129,6 +129,20 @@ class MemorySourceOp(Operator):
 
 
 @dataclasses.dataclass
+class UDTFSourceOp(Operator):
+    """Table-generating-function source (reference exec/udtf_source_node.*,
+    udf/udtf.h).  `schema` serializes the declared output relation so remote
+    executors don't need the UDTF registered locally to type-check."""
+
+    name: str = ""
+    args: dict = dataclasses.field(default_factory=dict)
+    schema: Optional[list] = None
+
+    def _fields(self):
+        return {"name": self.name, "args": self.args, "schema": self.schema}
+
+
+@dataclasses.dataclass
 class MapOp(Operator):
     """Projection + computed columns. exprs defines the FULL output column list
     (reference planpb MapOperator semantics)."""
@@ -390,6 +404,8 @@ def _op_from_dict(d: dict):
         )
     if k == "union":
         return UnionOp()
+    if k == "udtfsource":
+        return UDTFSourceOp(name=d["name"], args=dict(d["args"]), schema=d["schema"])
     if k == "resultsink":
         return ResultSinkOp(channel=d["channel"], payload=d["payload"])
     if k == "remotesource":

@@ -271,9 +271,15 @@ class Broker:
                         raise Internal(f"channel {cid}: expected row payloads")
                     inputs[cid] = _union_host_batches(got)
 
+            from pixie_tpu.udf.udtf import UDTFContext
+
             ex = PlanExecutor(
                 dp.merger_plan, self.merger_store, self.udf_registry,
                 inputs=inputs, analyze=analyze,
+                udtf_ctx=UDTFContext(
+                    table_store=self.merger_store, registry=reg,
+                    agent_registry=self.registry,
+                ),
             )
             results = ex.run()
             stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}

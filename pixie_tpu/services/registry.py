@@ -109,6 +109,12 @@ class AgentRegistry:
         return out
 
     # ------------------------------------------------------------------- views
+    def all_agents(self) -> list[AgentRecord]:
+        """Every known agent, dead or alive (GetAgentStatus shows both)."""
+        self.expire()
+        with self._lock:
+            return list(self._agents.values())
+
     def live_agents(self) -> list[AgentRecord]:
         self.expire()
         with self._lock:

@@ -1,0 +1,3 @@
+from pixie_tpu.testing.datagen import demo_metadata, build_demo_store
+
+__all__ = ["demo_metadata", "build_demo_store"]

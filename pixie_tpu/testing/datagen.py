@@ -1,0 +1,153 @@
+"""Synthetic cluster data generator: k8s metadata + telemetry tables.
+
+The script-execution tests, demos and benchmarks all need a plausible
+mini-cluster: pods/services/processes in the metadata state and rows in the
+canonical tables (collect.schemas).  The reference grows this from live eBPF
+capture; here it is generated — same shape, deterministic seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from pixie_tpu.collect.schemas import SCHEMAS
+from pixie_tpu.metadata.state import MetadataStateManager
+from pixie_tpu.table.table import TableStore
+from pixie_tpu.types import DataType as DT, UInt128
+
+SEC = 1_000_000_000
+
+_NAMESPACES = ["default", "payments"]
+_SERVICES = ["frontend", "cart", "checkout"]
+_PODS_PER_SVC = 2
+
+_REQ_PATHS = ["/api/v1/items", "/api/v1/cart", "/healthz", "/api/v2/pay", "/login"]
+_METHODS = ["GET", "POST", "PUT"]
+_SQLS = [
+    "SELECT * FROM users WHERE id=42",
+    "INSERT INTO orders VALUES (1, 'x')",
+    "SELECT count(*) FROM items",
+]
+_REDIS_CMDS = ["GET", "SET", "HGETALL", "EXPIRE"]
+_DNS_NAMES = ["svc-a.default.svc.cluster.local", "example.com", "db.payments"]
+
+
+def demo_metadata(asid: int = 1, node_name: str = "node-1"):
+    """Build a MetadataStateManager with pods/services/processes + the UPID
+    and IP universe the tables reference.  Returns (manager, upids, pod_ips)."""
+    m = MetadataStateManager(asid=asid, node_name=node_name)
+    updates = []
+    upids: list[UInt128] = []
+    ips: list[str] = []
+    pid = 100
+    for si, svc in enumerate(_SERVICES):
+        ns = _NAMESPACES[si % len(_NAMESPACES)]
+        svc_uid = f"svc-uid-{si}"
+        pod_uids = []
+        for pi in range(_PODS_PER_SVC):
+            uid = f"pod-uid-{si}-{pi}"
+            ip = f"10.0.{si}.{pi + 1}"
+            ips.append(ip)
+            pod_uids.append(uid)
+            updates.append({
+                "kind": "pod", "uid": uid, "name": f"{svc}-{pi}",
+                "namespace": ns, "node": node_name, "ip": ip,
+                "phase": "Running", "create_time_ns": 1 * SEC,
+            })
+            cid = f"ctr-{si}-{pi}"
+            updates.append({
+                "kind": "container", "cid": cid, "name": f"{svc}-ctr",
+                "pod_uid": uid, "state": "Running",
+            })
+            u = UInt128.make_upid(asid, pid, 1 * SEC + pid)
+            pid += 1
+            upids.append(u)
+            updates.append({
+                "kind": "process", "upid": u, "pod_uid": uid,
+                "container_id": cid, "cmdline": f"/bin/{svc} --serve",
+            })
+        updates.append({
+            "kind": "service", "uid": svc_uid, "name": svc, "namespace": ns,
+            "cluster_ip": f"10.96.0.{si + 1}", "pod_uids": pod_uids,
+        })
+        updates.append({"kind": "dns", "ip": f"10.96.0.{si + 1}",
+                        "hostname": f"{svc}.{ns}.svc.cluster.local"})
+    m.apply_updates(updates)
+    return m, upids, ips
+
+
+def _gen_column(name: str, dt: DT, n: int, rng, t0: int, t1: int, upids, ips):
+    if name == "time_":
+        return np.sort(rng.integers(t0, t1, n).astype(np.int64))
+    if dt == DT.UINT128:
+        return [upids[i] for i in rng.integers(0, len(upids), n)]
+    if name == "remote_addr":
+        pool = ips + ["192.168.9.9", "-"]
+        return [pool[i] for i in rng.integers(0, len(pool), n)]
+    if name == "pod_id":
+        pool = [f"pod-uid-{s}-{p}" for s in range(len(_SERVICES))
+                for p in range(_PODS_PER_SVC)]
+        return [pool[i] for i in rng.integers(0, len(pool), n)]
+    if name == "req_path":
+        return [_REQ_PATHS[i] for i in rng.integers(0, len(_REQ_PATHS), n)]
+    if name == "req_method":
+        return [_METHODS[i] for i in rng.integers(0, len(_METHODS), n)]
+    if name == "resp_status":
+        return rng.choice([200, 200, 200, 404, 500], n).astype(np.int64)
+    if name == "resp_message":
+        return ["OK"] * n
+    if name == "latency":
+        return (rng.exponential(2e6, n)).astype(np.int64)  # ~2ms
+    if name in ("req_body", "resp_body", "req", "resp"):
+        return [_SQLS[i] for i in rng.integers(0, len(_SQLS), n)]
+    if name == "req_cmd" and dt == DT.STRING:
+        return [["Query", "Parse", "Execute"][i] for i in rng.integers(0, 3, n)]
+    if name == "req_args":
+        return ["key-%d" % i for i in rng.integers(0, 20, n)]
+    if name in ("req_headers", "resp_headers", "req_header", "resp_header"):
+        return ['{"host": "example.com"}'] * n
+    if name == "stack_trace":
+        pool = ["main;run;work", "main;run;idle", "main;gc"]
+        return [pool[i] for i in rng.integers(0, 3, n)]
+    if name in ("cmd",):
+        return [_REDIS_CMDS[i] for i in rng.integers(0, len(_REDIS_CMDS), n)]
+    if dt == DT.STRING:
+        return ["x%d" % i for i in rng.integers(0, 10, n)]
+    if dt == DT.BOOLEAN:
+        return rng.integers(0, 2, n).astype(bool)
+    if dt == DT.FLOAT64:
+        return rng.exponential(10.0, n)
+    if name in ("remote_port",):
+        return rng.integers(1024, 60000, n).astype(np.int64)
+    if name == "trace_role":
+        return rng.integers(1, 3, n).astype(np.int64)  # requestor/responder
+    if name == "req_op" or (name == "req_cmd" and dt == DT.INT64):
+        return rng.integers(0, 8, n).astype(np.int64)
+    # generic int64 metric
+    return rng.integers(0, 1 << 20, n).astype(np.int64)
+
+
+def build_demo_store(
+    tables=None, rows: int = 4000, seed: int = 0,
+    now_ns: int = 600 * SEC, span_s: int = 300, batch_rows: int = 2048,
+) -> TableStore:
+    """TableStore with `rows` synthetic rows in each requested canonical
+    table, spanning [now-span_s, now).  Pair with demo_metadata() installed as
+    the global metadata manager so ctx[...] resolution finds the pods."""
+    from pixie_tpu.metadata import state as mdstate
+
+    mgr = mdstate.global_manager()
+    snap = mgr.current()
+    upids = sorted(snap.upid_to_pod_uid) or [UInt128.make_upid(1, 1, 1)]
+    ips = sorted(snap.ip_to_pod_uid) or ["10.0.0.1"]
+    rng = np.random.default_rng(seed)
+    ts = TableStore()
+    t0, t1 = now_ns - span_s * SEC, now_ns
+    for name in (tables or list(SCHEMAS)):
+        rel = SCHEMAS[name]
+        t = ts.create(name, rel, batch_rows=batch_rows)
+        data = {
+            c.name: _gen_column(c.name, c.data_type, rows, rng, t0, t1, upids, ips)
+            for c in rel
+        }
+        t.write(data)
+    return ts

@@ -6,4 +6,8 @@ from pixie_tpu.udf import builtins as _builtins
 registry = Registry()
 _builtins.register_all(registry)
 
+from pixie_tpu.udf.udtf import register_builtin_udtfs as _reg_udtfs  # noqa: E402
+
+_reg_udtfs(registry)
+
 __all__ = ["UDA", "ScalarUDF", "Registry", "registry"]

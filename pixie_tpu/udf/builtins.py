@@ -113,6 +113,10 @@ def register_all(r: Registry) -> None:
     r.register(_host("tolower", (_S,), _S, lambda s: s.lower()))
     r.register(_host("trim", (_S,), _S, lambda s: s.strip()))
     r.register(_host("atoi", (_S,), _I, _atoi))
+    r.register(_host("atoi", (_S, _I), _I, _atoi_default))
+    # String concatenation (reference string_ops.cc StringConcat / '+'):
+    # two dict columns evaluate over the observed pair cross-product LUT.
+    r.register(_host("add", (_S, _S), _S, lambda a, b: (a or "") + (b or "")))
     r.register(_host("bytes_to_hex", (_S,), _S, lambda s: s.encode().hex()))
     r.register(_host("hex_to_ascii", (_S,), _S, _hex_to_ascii))
     # strip_prefix(prefix, s) — reference string_ops.cc argument order.
@@ -159,6 +163,16 @@ def register_all(r: Registry) -> None:
     r.register(_host("normalize_mysql", (_S,), _S, _normalize_sql))
     r.register(_host("normalize_pgsql", (_S,), _S, _normalize_sql))
     r.register(_host("normalize_sql", (_S,), _S, _normalize_sql))
+    # 2-arg forms take the protocol command (mysql: int code, pgsql: tag
+    # string) and normalize only query-bearing commands (reference
+    # sql_ops.cc NormalizeMySQLUDF/NormalizePostgresUDF signatures).
+    r.register(_host("normalize_mysql", (_S, _I), _S,
+                     lambda q, cmd: _normalize_struct(q)))
+    r.register(_host("normalize_pgsql", (_S, _S), _S,
+                     lambda q, cmd: _normalize_struct(q)))
+    # JSON query-struct form the sql_queries scripts pluck fields out of
+    # (reference sql_ops.cc returns {"query": ..., "params": [...], "error"}).
+    r.register(_host("normalize_sql_struct", (_S,), _S, _normalize_struct))
 
     # ------------------------------------------------------------ PII redaction
     # (reference pii_ops.cc best-effort regex redaction)
@@ -191,8 +205,15 @@ def register_all(r: Registry) -> None:
 def _atoi(s: str) -> int:
     try:
         return int(s.strip())
-    except (ValueError, TypeError):
+    except (ValueError, TypeError, AttributeError):
         return 0
+
+
+def _atoi_default(s: str, default: int) -> int:
+    try:
+        return int(s.strip())
+    except (ValueError, TypeError, AttributeError):
+        return int(default)
 
 
 def _hex_to_ascii(s: str) -> str:
@@ -262,6 +283,12 @@ def _normalize_sql(q: str) -> str:
     q = _SQL_STRING_RE.sub("?", q)
     q = _SQL_NUMBER_RE.sub("?", q)
     return re.sub(r"\s+", " ", q).strip()
+
+
+def _normalize_struct(q: str) -> str:
+    import json as _json
+
+    return _json.dumps({"query": _normalize_sql(q or ""), "params": [], "error": ""})
 
 
 _PII_RES = [

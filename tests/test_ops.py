@@ -148,7 +148,7 @@ class TestRegistry:
         with pytest.raises(NotFound):
             registry.scalar("nope", ())
         with pytest.raises(NotFound):
-            registry.scalar("add", (DT.STRING, DT.STRING))
+            registry.scalar("add", (DT.STRING, DT.BOOLEAN))
 
     def test_host_string(self):
         f = registry.scalar("contains", (DT.STRING, DT.STRING))
