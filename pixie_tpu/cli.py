@@ -1,0 +1,256 @@
+"""`px`-style CLI (reference src/pixie_cli: run scripts, render tables, start
+services).
+
+    python -m pixie_tpu.cli run <script.pxl | bundle-dir>  [--broker H:P | --demo]
+    python -m pixie_tpu.cli explain <script.pxl>
+    python -m pixie_tpu.cli scripts --bundle DIR
+    python -m pixie_tpu.cli broker [--port P] [--datastore PATH]
+    python -m pixie_tpu.cli agent --name N --broker H:P [--connector seq_gen]
+
+Results render as aligned text tables with semantic-aware formatting
+(durations, bytes, percentages) — the CLI analog of the Live UI's table view.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+
+# ------------------------------------------------------------------ rendering
+
+
+def _fmt_duration(ns: float) -> str:
+    ns = float(ns)
+    for unit, div in (("s", 1e9), ("ms", 1e6), ("us", 1e3)):
+        if abs(ns) >= div:
+            return f"{ns / div:.2f}{unit}"
+    return f"{ns:.0f}ns"
+
+
+def _fmt_bytes(b: float) -> str:
+    b = float(b)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if abs(b) >= div:
+            return f"{b / div:.2f}{unit}"
+    return f"{b:.0f}B"
+
+
+def _formatter(col: str):
+    lc = col.lower()
+    if lc in ("latency", "latency_ns") or lc.endswith("_time_ns") or lc.endswith("duration_ns") or lc.startswith("latency_p"):
+        return _fmt_duration
+    if lc.endswith("_bytes") or lc.startswith("bytes_"):
+        return _fmt_bytes
+    if lc.endswith("_rate") or lc.endswith("_percent"):
+        return lambda v: f"{float(v) * 100:.2f}%"
+    return None
+
+
+def render_table(result, max_rows: int = 40) -> str:
+    """QueryResult → aligned text table."""
+    names = result.relation.names()
+    cols = {}
+    for n in names:
+        vals = result.decoded(n)
+        fmt = _formatter(n)
+        if fmt is not None:
+            try:
+                vals = [fmt(v) if v is not None else "" for v in vals]
+            except (TypeError, ValueError):
+                pass
+        cols[n] = ["" if v is None else str(v) for v in vals]
+    n_rows = result.num_rows
+    shown = min(n_rows, max_rows)
+    widths = {
+        n: max(len(n), *(len(cols[n][i]) for i in range(shown))) if shown else len(n)
+        for n in names
+    }
+    lines = ["  ".join(n.ljust(widths[n]) for n in names)]
+    lines.append("  ".join("-" * widths[n] for n in names))
+    for i in range(shown):
+        lines.append("  ".join(cols[n][i].ljust(widths[n]) for n in names))
+    if n_rows > shown:
+        lines.append(f"... ({n_rows - shown} more rows)")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- script run
+
+
+def _load_script(target: str):
+    """Accept a .pxl file OR a bundled-script directory (pxl + vis.json).
+    Returns (source, VisSpec|None, name)."""
+    from pixie_tpu.vis import parse_vis
+
+    p = pathlib.Path(target)
+    if p.is_dir():
+        pxls = sorted(p.glob("*.pxl"))
+        if not pxls:
+            raise SystemExit(f"{target}: no .pxl file in bundle dir")
+        vis_path = p / "vis.json"
+        vis = parse_vis(vis_path.read_text()) if vis_path.exists() else None
+        return pxls[0].read_text(), vis, p.name
+    return p.read_text(), None, p.stem
+
+
+def _demo_cluster():
+    """In-process demo data (no broker needed): canonical tables + metadata."""
+    from pixie_tpu.metadata.state import set_global_manager
+    from pixie_tpu.testing import build_demo_store, demo_metadata
+
+    mgr, _, _ = demo_metadata()
+    set_global_manager(mgr)
+    SEC = 1_000_000_000
+    now = time.time_ns()
+    store = build_demo_store(rows=20_000, now_ns=now, span_s=300)
+    return store, now
+
+
+def cmd_run(args) -> int:
+    source, vis, name = _load_script(args.script)
+    overrides = {}
+    for kv in args.arg or []:
+        if "=" not in kv:
+            raise SystemExit(f"--arg expects name=value, got {kv!r}")
+        k, v = kv.split("=", 1)
+        overrides[k] = v
+
+    runs: list[tuple[str, str | None, dict | None]] = [(name, None, None)]
+    if vis is not None and (vis.global_funcs or any(w.func for w in vis.widgets)):
+        runs = [(out, fn, fargs) for out, fn, fargs in vis.executions(overrides)]
+
+    if args.broker:
+        from pixie_tpu.services.client import Client
+
+        host, port = args.broker.rsplit(":", 1)
+        client = Client(host, int(port))
+        execute = lambda fn, fargs: client.execute_script(  # noqa: E731
+            source, func=fn, func_args=fargs, analyze=args.analyze
+        )
+    else:
+        from pixie_tpu.collect.schemas import all_schemas
+        from pixie_tpu.compiler import compile_pxl
+        from pixie_tpu.engine import execute_plan
+
+        store, now = _demo_cluster()
+        schemas = {**all_schemas(), **store.schemas()}
+
+        def execute(fn, fargs):
+            q = compile_pxl(source, schemas, func=fn, func_args=fargs, now=now)
+            return execute_plan(q.plan, store, analyze=args.analyze)
+
+    kinds = vis.widget_kinds() if vis is not None else {}
+    for out_name, fn, fargs in runs:
+        results = execute(fn, fargs)
+        for sink, res in results.items():
+            kind = kinds.get(out_name, "Table")
+            hdr = f"== {out_name}/{sink} [{kind}] ({res.num_rows} rows)"
+            print(hdr)
+            print(render_table(res, max_rows=args.max_rows))
+            if args.analyze and res.exec_stats.get("operators"):
+                from pixie_tpu.plan.debug import render_stats
+
+                print("-- exec stats:")
+                print(render_stats(res.exec_stats))
+            print()
+    return 0
+
+
+def cmd_explain(args) -> int:
+    from pixie_tpu.collect.schemas import all_schemas
+    from pixie_tpu.compiler import compile_pxl
+    from pixie_tpu.vis import parse_vis  # noqa: F401  (bundle support)
+
+    source, vis, _name = _load_script(args.script)
+    fn = fargs = None
+    if vis is not None:
+        runs = vis.executions({})
+        if runs:
+            _out, fn, fargs = runs[0]
+    q = compile_pxl(source, all_schemas(), func=fn, func_args=fargs)
+    print(q.plan.explain())
+    return 0
+
+
+def cmd_scripts(args) -> int:
+    bundle = pathlib.Path(args.bundle)
+    for d in sorted(bundle.iterdir()):
+        if not d.is_dir() or not list(d.glob("*.pxl")):
+            continue
+        desc = ""
+        manifest = d / "manifest.yaml"
+        if manifest.exists():
+            for line in manifest.read_text().splitlines():
+                if line.strip().startswith("short:"):
+                    desc = line.split(":", 1)[1].strip()
+                    break
+        print(f"{d.name:<36} {desc}")
+    return 0
+
+
+def cmd_broker(args) -> int:
+    from pixie_tpu.services.broker import Broker
+
+    broker = Broker(host=args.host, port=args.port,
+                    datastore_path=args.datastore).start()
+    print(f"broker listening on {args.host}:{broker.port} "
+          f"(datastore={args.datastore})", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        broker.stop()
+    return 0
+
+
+def cmd_agent(args) -> int:
+    from pixie_tpu.services.agent import main as agent_main
+
+    argv = ["--name", args.name, "--broker", args.broker]
+    for c in args.connector or []:
+        argv += ["--connector", c]
+    agent_main(argv)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="px-tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run a PxL script and render results")
+    run.add_argument("script", help=".pxl file or bundled-script directory")
+    run.add_argument("--broker", help="host:port (default: in-process demo data)")
+    run.add_argument("--arg", action="append", help="vis variable override k=v")
+    run.add_argument("--analyze", action="store_true")
+    run.add_argument("--max-rows", type=int, default=40)
+    run.set_defaults(fn=cmd_run)
+
+    exp = sub.add_parser("explain", help="compile and pretty-print the plan")
+    exp.add_argument("script")
+    exp.set_defaults(fn=cmd_explain)
+
+    sc = sub.add_parser("scripts", help="list bundled scripts")
+    sc.add_argument("--bundle", default="/root/reference/src/pxl_scripts/px")
+    sc.set_defaults(fn=cmd_scripts)
+
+    br = sub.add_parser("broker", help="start a query broker")
+    br.add_argument("--host", default="127.0.0.1")
+    br.add_argument("--port", type=int, default=59300)
+    br.add_argument("--datastore", default=":memory:")
+    br.set_defaults(fn=cmd_broker)
+
+    ag = sub.add_parser("agent", help="start an agent")
+    ag.add_argument("--name", required=True)
+    ag.add_argument("--broker", required=True)
+    ag.add_argument("--connector", action="append")
+    ag.set_defaults(fn=cmd_agent)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,6 +62,9 @@ INT64_MAX = np.iinfo(np.int64).max
 MAX_GROUPS = 1 << 22
 #: Sorted-fallback device reduction chunk (rows per update step).
 SORT_AGG_CHUNK = 1 << 20
+#: Minimum window-bin bucket: keeps the compiled group space stable across
+#: streaming polls whose deltas span few windows.
+MIN_WINDOW_BINS = 1 << 6
 
 
 class GroupKeyFallback(Unimplemented):
@@ -447,11 +450,12 @@ class ChainKernel:
                 )
             elif k.kind == "dict":
                 key_builders.append(k.key_sval.build)
-            else:  # window
-                sv = k.key_sval
-                w, t0 = k.width, k.t0_bin
+            else:  # window: origin is a runtime scalar in luts (streaming)
+                sv, w, t0name = k.key_sval, k.width, k.lut_name
                 key_builders.append(
-                    lambda env, sv=sv, w=w, t0=t0: (sv.build(env) // w - t0).astype(jnp.int32)
+                    lambda env, sv=sv, w=w, t0name=t0name: (
+                        sv.build(env) // w - env["luts"][t0name][0]
+                    ).astype(jnp.int32)
                 )
         cards = [k.card for k in keys]
 
@@ -912,15 +916,22 @@ class PlanExecutor:
                 t_min, t_max = _source_time_range(src, head)
                 t0_bin = t_min // width
                 nbins = int(t_max // width - t0_bin) + 1
+                # The window ORIGIN is a runtime parameter (fed through the
+                # luts dict, see _refresh_window_keys), NOT baked into the
+                # kernel: streaming polls and shifting '-5m' ranges then reuse
+                # one compiled kernel.  Only the bin-count bucket is static;
+                # it grows (cache bust) if a later range spans more bins.
+                t0name = kern.ctx.ec._add_lut(np.asarray([t0_bin], dtype=np.int64))
                 keys.append(
                     GroupKey(
                         name,
                         "window",
-                        next_pow2(max(nbins, 1)),
+                        next_pow2(max(nbins, MIN_WINDOW_BINS)),
                         sv.dtype,
                         width=width,
                         t0_bin=int(t0_bin),
                         key_sval=sv,
+                        lut_name=t0name,
                     )
                 )
                 continue
@@ -1141,10 +1152,14 @@ class PlanExecutor:
         fb_sig = None
         if isinstance(head, MemorySourceOp):
             extra = ["agg", _op_sig(op), ("mesh", self.mesh.size if self.mesh else 0)]
-            data_dependent = not all(g in dicts for g in op.groups)
+            windowish = _windowish_groups(chain, self.store.table(head.table).time_col)
+            # Only intdevice keys bake data (their unique-value sets); window
+            # origins are runtime parameters (_refresh_window_keys), so
+            # windowed/dict-keyed aggs reuse one kernel across polls/ranges.
+            data_dependent = any(
+                g not in dicts and g not in windowish for g in op.groups
+            )
             if data_dependent:
-                # intdevice key sets / window origins bake data; rows_written
-                # pins the snapshot, and window t0_bin depends on the bounds.
                 extra.append(self.store.table(head.table).stats()["rows_written"])
             sig = self._chain_cache_sig(
                 head, chain, dtypes, dicts, extra, include_times=data_dependent
@@ -1158,76 +1173,28 @@ class PlanExecutor:
             )
         if _cache_get(fb_sig) == "group_key_fallback":
             raise GroupKeyFallback(f"agg {op.id}: cached fallback decision")
-        cached = _cache_get(sig)
-        if cached is not None:
+        for _attempt in range(2):
+            built = self._agg_kernel(op, sig, fb_sig, dtypes, dicts, chain,
+                                     time_col, visible, src, head)
             (kern, keys, udas, in_types, init_specs, num_groups,
-             seen_name, step, partial_step, merge_fn, spmd_step) = cached
-            state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
+             seen_name, step, partial_step, merge_fn, spmd_step) = built
+            ok, keys, lut_over = self._refresh_window_keys(keys, src, head)
+            if ok:
+                break
+            # A cached kernel's window-bin bucket is too small for this run's
+            # time span: drop it and rebuild with the larger card.
+            _KERNEL_CACHE.pop(sig, None)
         else:
-            kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
-            try:
-                keys = self._plan_group_keys(op, kern, src, head)
-            except GroupKeyFallback:
-                _cache_put(fb_sig, "group_key_fallback")
-                raise
-            num_groups = 1
-            for k in keys:
-                num_groups *= k.card
-
-            # UDA instances + value builders (+ implicit row counter for
-            # seen-groups).
-            udas = []
-            init_specs = []
-            state = {}
-            seen_name = "__seen"
-            from pixie_tpu.udf.udf import CountUDA
-
-            in_types: dict[str, DT | None] = {}
-            for ae in [*op.values]:
-                uda = self.registry.uda(ae.fn)
-                vb = None
-                in_dtype = None
-                in_types[ae.out_name] = None
-                if ae.arg is not None:
-                    sv = kern.ctx.sym.get(ae.arg)
-                    if sv is None:
-                        raise CompilerError(f"agg input column {ae.arg!r} not found")
-                    if sv.dictionary is not None:
-                        raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
-                    vb = sv.build
-                    in_dtype = STORAGE_DTYPE[sv.dtype]
-                    in_types[ae.out_name] = sv.dtype
-                elif not uda.nullary:
-                    raise CompilerError(f"aggregate {ae.fn} requires an input column")
-                udas.append((ae.out_name, uda, vb))
-                init_specs.append((ae.out_name, uda, in_dtype))
-                state[ae.out_name] = uda.init(num_groups, in_dtype)
-            seen_uda = CountUDA()
-            udas.append((seen_name, seen_uda, None))
-            init_specs.append((seen_name, seen_uda, None))
-            state[seen_name] = seen_uda.init(num_groups)
-
-            step = kern.make_agg_step(keys, udas, num_groups)
-            partial_step = kern.make_partial_agg_step(keys, udas, num_groups, init_specs)
-            merge_fn = kern.make_merge_states(udas)
-            spmd_step = None
-            if self.mesh is not None:
-                from pixie_tpu.parallel.spmd import reduce_tree_for, spmd_partial_step
-
-                reduce_tree = reduce_tree_for(udas)
-                specs = list(init_specs)
-
-                def init_fn(specs=specs, g=num_groups):
-                    return {name: uda.init(g, in_dt) for name, uda, in_dt in specs}
-
-                spmd_step = spmd_partial_step(
-                    kern.raw_agg_step, init_fn, reduce_tree,
-                    len(kern.limit_ns), self.mesh,
-                )
-            _cache_put(sig, (kern, keys, udas, in_types, init_specs, num_groups,
-                             seen_name, step, partial_step, merge_fn, spmd_step))
+            # Both attempts failed: concurrent ingest grew the time span
+            # between the rebuild's range read and the refresh.  Running with
+            # a stale bucket would silently alias windows — fail loudly.
+            raise Internal(
+                "window-bin bucket overflowed twice (concurrent ingest "
+                "outpacing kernel rebuild); retry the query"
+            )
+        state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
         t_lo, t_hi = _time_bounds(head)
-        luts = kern.luts
+        luts = {**kern.luts, **lut_over} if lut_over else kern.luts
         with self._timed(
             self._chain_label(head, chain, "partial_agg"),
             ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
@@ -1237,6 +1204,98 @@ class PlanExecutor:
                 src, names, cap, t_lo, t_hi, luts,
             )
         return keys, udas, state_np, seen_name, in_types
+
+    def _refresh_window_keys(self, keys, src, head):
+        """Per-run window-origin resolution.
+
+        Returns (ok, keys', lut_overrides).  keys' holds fresh GroupKey copies
+        with this run's t0_bin, and lut_overrides carries the runtime origin
+        scalars — per-run values never mutate the cached kernel, so concurrent
+        queries over different time ranges can share it.  ok=False means the
+        kernel's static bin bucket can't hold this run's span (rebuild)."""
+        if not any(k.kind == "window" for k in keys):
+            return True, keys, {}
+        t_min, t_max = _source_time_range(src, head)
+        out, over = [], {}
+        for k in keys:
+            if k.kind != "window":
+                out.append(k)
+                continue
+            t0 = int(t_min // k.width)
+            nbins = int(t_max // k.width) - t0 + 1
+            if nbins > k.card:
+                return False, keys, {}
+            out.append(dataclasses.replace(k, t0_bin=t0))
+            over[k.lut_name] = np.asarray([t0], dtype=np.int64)
+        return True, out, over
+
+    def _agg_kernel(self, op, sig, fb_sig, dtypes, dicts, chain, time_col,
+                    visible, src, head):
+        """Fetch-or-build the compiled agg kernel bundle for `op`."""
+        cached = _cache_get(sig)
+        if cached is not None:
+            return cached
+        kern = ChainKernel(dtypes, dicts, chain, self.registry, time_col, visible)
+        try:
+            keys = self._plan_group_keys(op, kern, src, head)
+        except GroupKeyFallback:
+            _cache_put(fb_sig, "group_key_fallback")
+            raise
+        num_groups = 1
+        for k in keys:
+            num_groups *= k.card
+
+        # UDA instances + value builders (+ implicit row counter for
+        # seen-groups).
+        udas = []
+        init_specs = []
+        seen_name = "__seen"
+        from pixie_tpu.udf.udf import CountUDA
+
+        in_types: dict[str, DT | None] = {}
+        for ae in [*op.values]:
+            uda = self.registry.uda(ae.fn)
+            vb = None
+            in_dtype = None
+            in_types[ae.out_name] = None
+            if ae.arg is not None:
+                sv = kern.ctx.sym.get(ae.arg)
+                if sv is None:
+                    raise CompilerError(f"agg input column {ae.arg!r} not found")
+                if sv.dictionary is not None:
+                    raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
+                vb = sv.build
+                in_dtype = STORAGE_DTYPE[sv.dtype]
+                in_types[ae.out_name] = sv.dtype
+            elif not uda.nullary:
+                raise CompilerError(f"aggregate {ae.fn} requires an input column")
+            udas.append((ae.out_name, uda, vb))
+            init_specs.append((ae.out_name, uda, in_dtype))
+        seen_uda = CountUDA()
+        udas.append((seen_name, seen_uda, None))
+        init_specs.append((seen_name, seen_uda, None))
+
+        step = kern.make_agg_step(keys, udas, num_groups)
+        partial_step = kern.make_partial_agg_step(keys, udas, num_groups, init_specs)
+        merge_fn = kern.make_merge_states(udas)
+        spmd_step = None
+        if self.mesh is not None:
+            from pixie_tpu.parallel.spmd import reduce_tree_for, spmd_partial_step
+
+            reduce_tree = reduce_tree_for(udas)
+            specs = list(init_specs)
+
+            def init_fn(specs=specs, g=num_groups):
+                return {name: uda.init(g, in_dt) for name, uda, in_dt in specs}
+
+            spmd_step = spmd_partial_step(
+                kern.raw_agg_step, init_fn, reduce_tree,
+                len(kern.limit_ns), self.mesh,
+            )
+        bundle = (kern, keys, udas, in_types, init_specs, num_groups,
+                  seen_name, step, partial_step, merge_fn, spmd_step)
+        _cache_put(sig, bundle)
+        return bundle
 
     def _agg_feed_loop(self, kern, step, partial_step, merge_fn, spmd_step,
                        state, src, names, cap, t_lo, t_hi, luts):
@@ -1406,6 +1465,12 @@ class PlanExecutor:
         from pixie_tpu.udf.udtf import UDTFContext
 
         u = self.registry.udtf(op.name)
+        # The serialized schema (when present) is authoritative for the output
+        # relation — a remote plan's view of the UDTF wins over whatever
+        # version is registered locally.
+        relation = (
+            Relation.from_dict(op.schema) if op.schema is not None else u.relation
+        )
         ctx = self.udtf_ctx
         if ctx is None:
             from pixie_tpu.metadata import state as _mdstate
@@ -1417,7 +1482,7 @@ class PlanExecutor:
             )
         cols_raw = u.fn(ctx, **(op.args or {}))
         dtypes, dicts, cols = {}, {}, {}
-        for c in u.relation:
+        for c in relation:
             if c.name not in cols_raw:
                 raise Internal(
                     f"UDTF {op.name} did not produce declared column {c.name!r}"
@@ -1608,6 +1673,30 @@ def _time_bounds(head) -> tuple[np.int64, np.int64]:
         hi = INT64_MAX if head.stop_time is None else int(head.stop_time)
         return np.int64(lo), np.int64(hi)
     return np.int64(INT64_MIN), np.int64(INT64_MAX)
+
+
+def _windowish_groups(chain, time_col: Optional[str]) -> dict[str, int]:
+    """Group-key names whose FINAL definition in the chain is px.bin over the
+    time column (candidates for runtime-origin window keys; used for cache-sig
+    planning BEFORE the kernel is built).
+
+    Tracks each Map's full output list in order — a later redefinition of the
+    column to anything else drops its window-ness (matching the provenance
+    resolution in _plan_group_keys), while a plain rename passes it through.
+    """
+    out: dict[str, int] = {}
+    for op in chain:
+        if not isinstance(op, MapOp):
+            continue
+        new: dict[str, int] = {}
+        for name, e in op.exprs:
+            w = _window_key(e, time_col)
+            if w is not None:
+                new[name] = w
+            elif isinstance(e, Column) and e.name in out:
+                new[name] = out[e.name]  # passthrough keeps window-ness
+        out = new
+    return out
 
 
 def _window_key(expr, time_col: Optional[str]) -> Optional[int]:

@@ -1,0 +1,4 @@
+from pixie_tpu.ml.kmeans import KMeans, kmeans_fit
+from pixie_tpu.ml.coreset import CoresetTree, kmeans_coreset
+
+__all__ = ["KMeans", "kmeans_fit", "CoresetTree", "kmeans_coreset"]

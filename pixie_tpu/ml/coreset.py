@@ -1,0 +1,96 @@
+"""k-means coresets + streaming coreset tree.
+
+Reference: src/carnot/exec/ml/coreset.h — KMeansCoreset (sensitivity-sampled
+weighted subset preserving the k-means cost) and CoresetTree (merge-and-reduce
+over streaming batches, so an unbounded stream keeps a bounded summary).
+
+TPU redesign: sensitivity scores are computed with the same matmul distance
+kernel as kmeans; sampling is one categorical draw.  The tree is tiny host
+orchestration over device-computed coresets — exactly the framework's split of
+"host drives, device does the math".
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pixie_tpu.ml.kmeans import _sq_dists, kmeans_fit
+
+
+def kmeans_coreset(points, weights, m: int, k: int = 8, seed: int = 0):
+    """Sensitivity-sampled coreset of size m (coreset.h KMeansCoreset).
+
+    Sensitivity of point p (Bachem-style lightweight coreset): proportional to
+    w_p * (d(p, B)^2 / cost + 1/|B-cluster mass|), with B a rough k-means
+    solution.  Returns (points [m,d], weights [m])."""
+    x = jnp.asarray(points, dtype=jnp.float32)
+    w = jnp.asarray(weights, dtype=jnp.float32)
+    n = x.shape[0]
+    if m >= n:
+        return np.asarray(x), np.asarray(w)
+    centers, assign = kmeans_fit(x, min(k, n), weights=w, max_iters=5, seed=seed)
+    c = jnp.asarray(centers)
+    a = jnp.asarray(assign)
+    d2 = jnp.min(_sq_dists(x, c), axis=1)
+    cost = jnp.sum(w * d2) + 1e-30
+    cluster_mass = jax.ops.segment_sum(w, a, num_segments=c.shape[0])
+    mass_term = 1.0 / jnp.maximum(cluster_mass[a], 1e-30)
+    sens = w * (d2 / cost) + w * mass_term / jnp.sum(w)
+    p = sens / jnp.sum(sens)
+    key = jax.random.PRNGKey(seed + 1)
+    idx = jax.random.choice(key, n, shape=(m,), replace=True, p=p)
+    # unbiased estimator: sampled weight = w / (m * p)
+    wout = w[idx] / (m * p[idx])
+    return np.asarray(x[idx]), np.asarray(wout)
+
+
+class CoresetTree:
+    """Merge-and-reduce streaming summary (coreset.h CoresetTree/CoresetDriver).
+
+    update(batch) buffers points; whenever two summaries of the same level
+    exist they merge and re-compress to `m` points, so memory is
+    O(m log(stream/batch)) and query() returns one coreset of the whole
+    stream."""
+
+    def __init__(self, m: int = 1024, k: int = 8, seed: int = 0):
+        self.m = m
+        self.k = k
+        self.seed = seed
+        #: level -> (points, weights)
+        self._levels: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+        self._n_seen = 0
+
+    def update(self, points, weights=None) -> None:
+        pts = np.asarray(points, dtype=np.float32)
+        w = (
+            np.ones(len(pts), dtype=np.float32)
+            if weights is None
+            else np.asarray(weights, dtype=np.float32)
+        )
+        self._n_seen += len(pts)
+        if len(pts) > self.m:
+            pts, w = kmeans_coreset(pts, w, self.m, self.k, self.seed)
+        level = 0
+        while level in self._levels:
+            opts, ow = self._levels.pop(level)
+            pts = np.concatenate([pts, opts])
+            w = np.concatenate([w, ow])
+            pts, w = kmeans_coreset(pts, w, self.m, self.k, self.seed + level)
+            level += 1
+        self._levels[level] = (pts, w)
+
+    def query(self) -> tuple[np.ndarray, np.ndarray]:
+        """One coreset summarizing everything seen."""
+        if not self._levels:
+            return np.empty((0, 0), np.float32), np.empty((0,), np.float32)
+        parts = [self._levels[l] for l in sorted(self._levels)]
+        pts = np.concatenate([p for p, _ in parts])
+        w = np.concatenate([x for _, x in parts])
+        if len(pts) > self.m:
+            pts, w = kmeans_coreset(pts, w, self.m, self.k, self.seed)
+        return pts, w
+
+    @property
+    def n_seen(self) -> int:
+        return self._n_seen

@@ -1,0 +1,126 @@
+"""Weighted k-means on device.
+
+Reference: src/carnot/exec/ml/kmeans.h — Eigen k-means with kmeans++ init over
+a WeightedPointSet, used for request-path clustering and the online ML path.
+
+TPU redesign: everything is batched linear algebra — pairwise distances are a
+single `x @ c.T` matmul (MXU), Lloyd iterations run under `lax.scan` with
+segment-sums for the center updates, and kmeans++ seeding uses `lax.scan` over
+k steps with distance matmuls.  No per-point Python loops anywhere; shapes are
+static in (n, d, k).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _sq_dists(x: jax.Array, c: jax.Array) -> jax.Array:
+    """[n, k] squared euclidean distances via the matmul expansion
+    |x|^2 - 2 x·c + |c|^2 (one MXU matmul instead of n·k vector ops)."""
+    x2 = jnp.sum(x * x, axis=1, keepdims=True)
+    c2 = jnp.sum(c * c, axis=1)
+    d = x2 - 2.0 * (x @ c.T) + c2[None, :]
+    return jnp.maximum(d, 0.0)
+
+
+def _plusplus_init(key, x: jax.Array, w: jax.Array, k: int) -> jax.Array:
+    """kmeans++ seeding (kmeans.h kKMeansPlusPlus): each next center sampled
+    proportional to weighted squared distance to the nearest chosen center."""
+    n = x.shape[0]
+    k0, key = jax.random.split(key)
+    first = jax.random.choice(k0, n, p=w / jnp.sum(w))
+    centers0 = jnp.zeros((k, x.shape[1]), dtype=x.dtype).at[0].set(x[first])
+
+    def step(carry, i):
+        centers, key = carry
+        d = _sq_dists(x, centers)
+        # distance to nearest ALREADY-CHOSEN center: mask out unset slots
+        slot = jnp.arange(k) < i
+        d = jnp.where(slot[None, :], d, jnp.inf)
+        mind = jnp.min(d, axis=1)
+        p = mind * w
+        p = jnp.where(jnp.isfinite(p), p, 0.0)
+        total = jnp.sum(p)
+        p = jnp.where(total > 0, p / total, w / jnp.sum(w))
+        kc, key = jax.random.split(key)
+        nxt = jax.random.choice(kc, n, p=p)
+        centers = centers.at[i].set(x[nxt])
+        return (centers, key), None
+
+    (centers, _), _ = jax.lax.scan(step, (centers0, key), jnp.arange(1, k))
+    return centers
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _lloyd(x, w, centers, iters: int = 10):
+    k = centers.shape[0]
+
+    def step(c, _):
+        assign = jnp.argmin(_sq_dists(x, c), axis=1)
+        wsum = jax.ops.segment_sum(w, assign, num_segments=k)
+        xsum = jax.ops.segment_sum(x * w[:, None], assign, num_segments=k)
+        newc = jnp.where(wsum[:, None] > 0, xsum / jnp.maximum(wsum, 1e-30)[:, None], c)
+        return newc, None
+
+    centers, _ = jax.lax.scan(step, centers, None, length=iters)
+    assign = jnp.argmin(_sq_dists(x, centers), axis=1)
+    return centers, assign
+
+
+def kmeans_fit(points, k: int, weights=None, max_iters: int = 10, seed: int = 0):
+    """Fit weighted k-means; returns (centers [k,d], assignments [n])."""
+    x = jnp.asarray(points, dtype=jnp.float32)
+    n = x.shape[0]
+    w = (
+        jnp.ones((n,), dtype=jnp.float32)
+        if weights is None
+        else jnp.asarray(weights, dtype=jnp.float32)
+    )
+    if k <= 0 or k > n:
+        raise ValueError(f"k={k} out of range for {n} points")
+    centers = _plusplus_init(jax.random.PRNGKey(seed), x, w, k)
+    centers, assign = _lloyd(x, w, centers, max_iters)
+    return np.asarray(centers), np.asarray(assign)
+
+
+@dataclasses.dataclass
+class KMeans:
+    """Stateful wrapper mirroring the reference API (kmeans.h KMeans::Fit /
+    Transform): Fit replaces the model; transform assigns cluster ids."""
+
+    k: int
+    max_iters: int = 10
+    seed: int = 0
+    centers: np.ndarray | None = None
+
+    def fit(self, points, weights=None) -> "KMeans":
+        self.centers, _ = kmeans_fit(
+            points, self.k, weights=weights, max_iters=self.max_iters, seed=self.seed
+        )
+        return self
+
+    def transform(self, points) -> np.ndarray:
+        if self.centers is None:
+            raise ValueError("KMeans.transform before fit")
+        d = _sq_dists(
+            jnp.asarray(points, dtype=jnp.float32),
+            jnp.asarray(self.centers, dtype=jnp.float32),
+        )
+        return np.asarray(jnp.argmin(d, axis=1))
+
+    def inertia(self, points, weights=None) -> float:
+        d = _sq_dists(
+            jnp.asarray(points, dtype=jnp.float32),
+            jnp.asarray(self.centers, dtype=jnp.float32),
+        )
+        mind = jnp.min(d, axis=1)
+        if weights is not None:
+            mind = mind * jnp.asarray(weights, dtype=jnp.float32)
+        return float(jnp.sum(mind))

@@ -10,4 +10,10 @@ from pixie_tpu.udf.udtf import register_builtin_udtfs as _reg_udtfs  # noqa: E40
 
 _reg_udtfs(registry)
 
+from pixie_tpu.ml.request_path import (  # noqa: E402
+    register_request_path_funcs as _reg_rp,
+)
+
+_reg_rp(registry)
+
 __all__ = ["UDA", "ScalarUDF", "Registry", "registry"]

@@ -1,0 +1,138 @@
+"""Vis spec parsing — the per-script visualization contract.
+
+Reference: src/api/proto/vispb/vis.proto:58-303 — each bundled script ships a
+`vis.json` declaring variables (typed, defaulted), global funcs (script entry
+points + arg bindings), and widgets (display spec per func output).  The CLI
+uses this to run a script exactly as the Live UI would: resolve variables,
+execute every referenced func, attach the widget display kind to each output.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+#: per-variable-type fallback when a vis variable has no defaultValue
+TYPE_DEFAULTS = {
+    "PX_STRING": "-5m",
+    "PX_SERVICE": "default/svc",
+    "PX_POD": "default/pod",
+    "PX_NAMESPACE": "default",
+    "PX_NODE": "node-1",
+    "PX_INT64": "10",
+    "PX_FLOAT64": "1.0",
+    "PX_BOOLEAN": "true",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Variable:
+    name: str
+    type: str
+    default: Optional[str]
+    description: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class FuncCall:
+    name: str
+    #: arg name -> ("variable", var_name) | ("value", literal)
+    args: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Widget:
+    name: str
+    kind: str  # short display-spec type, e.g. "TimeseriesChart"
+    func: Optional[FuncCall]
+    global_output: Optional[str]
+
+
+@dataclasses.dataclass
+class VisSpec:
+    variables: list[Variable]
+    global_funcs: dict[str, FuncCall]  # outputName -> func
+    widgets: list[Widget]
+
+    def variable_values(self, overrides: Optional[dict] = None) -> dict[str, str]:
+        out = {}
+        for v in self.variables:
+            if overrides and v.name in overrides:
+                out[v.name] = overrides[v.name]
+            elif v.default is not None:
+                out[v.name] = v.default
+            else:
+                out[v.name] = TYPE_DEFAULTS.get(v.type, "")
+        return out
+
+    def executions(self, overrides: Optional[dict] = None) -> list[tuple[str, str, dict]]:
+        """[(output_name, func_name, resolved_args)] — everything the UI would
+        run, deduped."""
+        values = self.variable_values(overrides)
+
+        def resolve(fc: FuncCall) -> dict:
+            out = {}
+            for name, (kind, v) in fc.args:
+                out[name] = values[v] if kind == "variable" else v
+            return out
+
+        seen = set()
+        runs = []
+        for out_name, fc in self.global_funcs.items():
+            key = (fc.name, tuple(sorted(resolve(fc).items())))
+            if key not in seen:
+                seen.add(key)
+                runs.append((out_name, fc.name, resolve(fc)))
+        for w in self.widgets:
+            if w.func is not None:
+                args = resolve(w.func)
+                key = (w.func.name, tuple(sorted(args.items())))
+                if key not in seen:
+                    seen.add(key)
+                    runs.append((w.name, w.func.name, args))
+        return runs
+
+    def widget_kinds(self) -> dict[str, str]:
+        """output/widget name -> display kind (table, TimeseriesChart, ...)."""
+        out = {}
+        for w in self.widgets:
+            target = w.global_output or w.name
+            out[target] = w.kind
+        return out
+
+
+def _parse_func(d: dict) -> FuncCall:
+    args = []
+    for a in d.get("args", []):
+        if "variable" in a:
+            args.append((a["name"], ("variable", a["variable"])))
+        else:
+            args.append((a["name"], ("value", a.get("value"))))
+    return FuncCall(name=d["name"], args=tuple(args))
+
+
+def parse_vis(data) -> VisSpec:
+    """Parse a vis.json dict (or JSON text)."""
+    if isinstance(data, (str, bytes)):
+        data = json.loads(data)
+    variables = [
+        Variable(
+            name=v["name"], type=v.get("type", "PX_STRING"),
+            default=v.get("defaultValue"), description=v.get("description", ""),
+        )
+        for v in data.get("variables", [])
+    ]
+    gfuncs = {
+        gf["outputName"]: _parse_func(gf["func"])
+        for gf in data.get("globalFuncs", [])
+    }
+    widgets = []
+    for w in data.get("widgets", []):
+        spec_type = w.get("displaySpec", {}).get("@type", "")
+        kind = spec_type.rsplit(".", 1)[-1] if spec_type else "Table"
+        widgets.append(Widget(
+            name=w.get("name", ""), kind=kind,
+            func=_parse_func(w["func"]) if "func" in w else None,
+            global_output=w.get("globalFuncOutputName"),
+        ))
+    return VisSpec(variables=variables, global_funcs=gfuncs, widgets=widgets)

@@ -1,0 +1,139 @@
+"""CLI + vis spec: run/explain/scripts against the demo cluster and a broker.
+
+Reference: src/pixie_cli (px run), src/api/proto/vispb/vis.proto (vis specs).
+"""
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pixie_tpu.vis import parse_vis
+from pixie_tpu.cli import main, render_table
+
+BUNDLE = pathlib.Path("/root/reference/src/pxl_scripts/px")
+
+
+def test_parse_vis_executions_and_kinds():
+    vis = parse_vis((BUNDLE / "service" / "vis.json").read_text())
+    assert any(v.name == "start_time" for v in vis.variables)
+    runs = vis.executions({"service": "default/frontend"})
+    assert runs, "no executions resolved"
+    for _out, fname, args in runs:
+        assert fname
+        assert args.get("service") == "default/frontend"
+    kinds = vis.widget_kinds()
+    assert "TimeseriesChart" in set(kinds.values())
+
+
+def test_render_table_formats_semantics():
+    from pixie_tpu.engine.result import QueryResult
+    from pixie_tpu.types import ColumnSchema, DataType as DT, Relation
+
+    rel = Relation([
+        ColumnSchema("svc", DT.STRING), ColumnSchema("latency", DT.INT64),
+        ColumnSchema("total_bytes", DT.INT64), ColumnSchema("error_rate", DT.FLOAT64),
+    ])
+    from pixie_tpu.table.dictionary import Dictionary
+
+    d = Dictionary(["a"])
+    qr = QueryResult(
+        name="x", relation=rel,
+        columns={
+            "svc": np.array([0], dtype=np.int32),
+            "latency": np.array([2_500_000], dtype=np.int64),
+            "total_bytes": np.array([3 * (1 << 20)], dtype=np.int64),
+            "error_rate": np.array([0.125]),
+        },
+        dictionaries={"svc": d},
+    )
+    text = render_table(qr)
+    assert "2.50ms" in text
+    assert "3.00MiB" in text
+    assert "12.50%" in text
+
+
+def test_cli_run_demo_bundled_script(capsys):
+    rc = main(["run", str(BUNDLE / "http_data"), "--max-rows", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "rows)" in out and "==" in out
+
+
+def test_cli_run_pxl_file_with_analyze(tmp_path, capsys):
+    f = tmp_path / "q.pxl"
+    f.write_text(
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df.groupby('req_method').agg(cnt=('latency', px.count))\n"
+        "px.display(df, 'by_method')\n"
+    )
+    rc = main(["run", str(f), "--analyze"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "by_method" in out
+    assert "exec stats" in out
+
+
+def test_cli_explain(tmp_path, capsys):
+    f = tmp_path / "q.pxl"
+    f.write_text(
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df[df.resp_status == 500]\n"
+        "px.display(df, 'errs')\n"
+    )
+    rc = main(["explain", str(f)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "MemorySource table=http_events" in out
+    assert "Filter" in out
+
+
+def test_cli_scripts_lists_bundle(capsys):
+    rc = main(["scripts"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "http_data" in out and "net_flow_graph" in out
+
+
+def test_cli_run_against_broker():
+    """End-to-end through a real broker + agent, driven via the CLI module."""
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    broker = Broker().start()
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("x", DT.INT64))
+    ts.create("seq0", rel).write({
+        "time_": np.arange(100, dtype=np.int64), "x": np.arange(100) % 10,
+    })
+    agent = Agent("pem1", "127.0.0.1", broker.port, store=ts).start()
+    try:
+        script = (
+            "import px\n"
+            "df = px.DataFrame(table='seq0')\n"
+            "df = df.groupby('x').agg(cnt=('time_', px.count))\n"
+            "px.display(df, 'out')\n"
+        )
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".pxl", delete=False) as f:
+            f.write(script)
+            path = f.name
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = main(["run", path, "--broker", f"127.0.0.1:{broker.port}"])
+        assert rc == 0
+        assert "out" in buf.getvalue()
+        assert "(10 rows)" in buf.getvalue()
+    finally:
+        agent.stop()
+        broker.stop()

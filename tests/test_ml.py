@@ -1,0 +1,103 @@
+"""ML exec kernels: kmeans, coresets, request-path clustering.
+
+Reference: src/carnot/exec/ml/kmeans.h, ml/coreset.h,
+funcs/builtins/request_path_ops.cc.
+"""
+import numpy as np
+
+from pixie_tpu.ml import CoresetTree, KMeans, kmeans_coreset, kmeans_fit
+from pixie_tpu.ml.request_path import RequestPathClustering, templatize
+
+
+def _blobs(rng, centers, n_per, scale=0.1):
+    pts = []
+    for c in centers:
+        pts.append(rng.normal(0, scale, (n_per, len(c))) + np.asarray(c))
+    return np.concatenate(pts)
+
+
+def test_kmeans_recovers_separated_blobs():
+    rng = np.random.default_rng(0)
+    true = [(0.0, 0.0), (10.0, 0.0), (0.0, 10.0), (10.0, 10.0)]
+    x = _blobs(rng, true, 200)
+    centers, assign = kmeans_fit(x, 4, max_iters=20, seed=1)
+    assert centers.shape == (4, 2)
+    # every true center has a fitted center within 0.5
+    for t in true:
+        d = np.min(np.linalg.norm(centers - np.asarray(t), axis=1))
+        assert d < 0.5, f"center {t} not recovered (nearest {d})"
+    # assignments are consistent: points of one blob share a label
+    labels = assign.reshape(4, 200)
+    for row in labels:
+        vals, counts = np.unique(row, return_counts=True)
+        assert counts.max() >= 195
+
+
+def test_kmeans_weighted():
+    rng = np.random.default_rng(1)
+    x = np.concatenate([rng.normal(0, 0.05, (50, 1)), rng.normal(5, 0.05, (50, 1))])
+    w = np.concatenate([np.full(50, 100.0), np.full(50, 1.0)])
+    km = KMeans(k=2, max_iters=15, seed=2).fit(x, weights=w)
+    got = np.sort(km.centers.ravel())
+    np.testing.assert_allclose(got, [0.0, 5.0], atol=0.2)
+    labels = km.transform(np.array([[0.1], [4.9]]))
+    assert labels[0] != labels[1]
+
+
+def test_coreset_preserves_kmeans_cost():
+    rng = np.random.default_rng(2)
+    true = [(0.0, 0.0), (20.0, 0.0), (0.0, 20.0)]
+    x = _blobs(rng, true, 2000, scale=0.5)
+    w = np.ones(len(x))
+    cp, cw = kmeans_coreset(x, w, m=300, k=3, seed=3)
+    assert len(cp) == 300
+    # total weight is approximately preserved (unbiased estimator)
+    assert abs(cw.sum() - len(x)) / len(x) < 0.35
+    # kmeans on the coreset recovers the same centers
+    centers, _ = kmeans_fit(cp, 3, weights=cw, max_iters=20, seed=4)
+    for t in true:
+        d = np.min(np.linalg.norm(centers - np.asarray(t), axis=1))
+        assert d < 1.5
+
+
+def test_coreset_tree_streaming():
+    rng = np.random.default_rng(5)
+    tree = CoresetTree(m=256, k=4, seed=6)
+    true = [(0.0, 0.0), (30.0, 0.0)]
+    for _batch in range(8):
+        tree.update(_blobs(rng, true, 500, scale=0.3))
+    assert tree.n_seen == 8 * 1000
+    pts, w = tree.query()
+    assert len(pts) <= 256
+    centers, _ = kmeans_fit(pts, 2, weights=w, max_iters=20, seed=7)
+    for t in true:
+        d = np.min(np.linalg.norm(centers - np.asarray(t), axis=1))
+        assert d < 2.0
+
+
+def test_templatize():
+    assert templatize("/api/v1/users/12345") == "/api/v1/users/*"
+    assert templatize("/api/v1/users/deadbeef01") == "/api/v1/users/*"
+    assert templatize("/healthz") == "/healthz"
+    assert (
+        templatize("/orders/550e8400-e29b-41d4-a716-446655440000/items")
+        == "/orders/*/items"
+    )
+
+
+def test_request_path_clustering_generalizes_varying_segment():
+    paths = [f"/api/v1/products/sku-{i}" for i in range(50)] + [
+        "/api/v1/cart", "/healthz",
+    ]
+    c = RequestPathClustering(branch_limit=8).fit(paths)
+    assert "/api/v1/products/*" in c.templates
+    assert c.predict("/api/v1/products/sku-99") == "/api/v1/products/*"
+    assert c.predict("/healthz") == "/healthz"
+
+
+def test_request_path_udf_registered():
+    from pixie_tpu.udf import registry
+    from pixie_tpu.types import DataType as DT
+
+    udf = registry.scalar("request_path_endpoint", (DT.STRING,))
+    assert udf.fn("/u/123") == "/u/*"
