@@ -102,7 +102,6 @@ def _http_df(ts):
 
     cur = ts.table("http_events").cursor()
     cols = {"time_": [], "service": [], "latency": [], "status": []}
-    svc_dict = ts.table("http_events").dictionaries["service"]
     for rb, _, _ in cur:
         for k in cols:
             cols[k].append(rb.columns[k][: rb.num_valid])

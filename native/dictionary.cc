@@ -1,0 +1,99 @@
+// Native dictionary encoder — the ingest hot loop.
+//
+// Reference analog: the C++ string handling inside ColumnWrapper/DataTable
+// (src/shared/types/column_wrapper.h, src/stirling/core/data_table.h) — the
+// reference's ingest is C++ end to end.  Here the Python Dictionary keeps the
+// value list (decode stays pure-python) while THIS index does the O(rows)
+// value→code hashing over numpy's fixed-width UCS4 string grids, called via
+// ctypes with zero copies.
+//
+// Build: see pixie_tpu/native/build.py (g++ -O3 -shared -fPIC).
+//
+// Layout contract (matches numpy 'U' arrays): n rows, `stride` uint32 code
+// points per row, rows padded with NUL.  Codes are dense int32, assigned in
+// first-occurrence order — identical to the Python fallback's assignment so
+// either path yields byte-identical tables.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Dict {
+  // Key storage must be pointer-stable across growth: deque never relocates
+  // existing elements.
+  std::deque<std::string> keys;  // raw UCS4 bytes, trimmed of trailing NULs
+  std::unordered_map<std::string_view, int32_t> index;
+
+  int32_t insert(std::string_view raw) {
+    auto it = index.find(raw);
+    if (it != index.end()) return it->second;
+    keys.emplace_back(raw);
+    int32_t code = static_cast<int32_t>(keys.size()) - 1;
+    index.emplace(std::string_view(keys.back()), code);
+    return code;
+  }
+};
+
+inline std::string_view row_view(const uint32_t* data, int64_t stride, int64_t i) {
+  const uint32_t* row = data + i * stride;
+  int64_t len = stride;
+  while (len > 0 && row[len - 1] == 0) --len;  // numpy pads rows with NUL
+  return {reinterpret_cast<const char*>(row),
+          static_cast<size_t>(len) * sizeof(uint32_t)};
+}
+
+}  // namespace
+
+extern "C" {
+
+void* px_dict_new() { return new Dict(); }
+
+void px_dict_free(void* h) { delete static_cast<Dict*>(h); }
+
+int64_t px_dict_size(void* h) {
+  return static_cast<int64_t>(static_cast<Dict*>(h)->keys.size());
+}
+
+// Batch encode n rows of a UCS4 grid.  out_codes[n] receives the codes;
+// new_first_idx receives, for each NEWLY-inserted value (in insertion order),
+// the batch row index of its first occurrence, so the caller can mirror the
+// Python-side value list.  Returns the number of new values.
+int64_t px_dict_encode_ucs4(void* h, const uint32_t* data, int64_t n,
+                            int64_t stride, int32_t* out_codes,
+                            int64_t* new_first_idx) {
+  Dict* d = static_cast<Dict*>(h);
+  const int64_t size_before = static_cast<int64_t>(d->keys.size());
+  int64_t n_new = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t code = d->insert(row_view(data, stride, i));
+    if (code >= size_before + n_new) {
+      new_first_idx[n_new++] = i;
+    }
+    out_codes[i] = code;
+  }
+  return n_new;
+}
+
+// Single insert used to keep the native index in sync when the Python side
+// adds a value directly (literal lookups).  Returns the value's code.
+int32_t px_dict_insert_ucs4(void* h, const uint32_t* data, int64_t len) {
+  Dict* d = static_cast<Dict*>(h);
+  std::string_view raw(reinterpret_cast<const char*>(data),
+                       static_cast<size_t>(len) * sizeof(uint32_t));
+  // trim trailing NULs for consistency with row_view
+  while (raw.size() >= sizeof(uint32_t)) {
+    uint32_t last;
+    std::memcpy(&last, raw.data() + raw.size() - sizeof(uint32_t), sizeof(uint32_t));
+    if (last != 0) break;
+    raw.remove_suffix(sizeof(uint32_t));
+  }
+  return d->insert(raw);
+}
+
+}  // extern "C"

@@ -50,11 +50,14 @@ def _formatter(col: str):
 
 
 def render_table(result, max_rows: int = 40) -> str:
-    """QueryResult → aligned text table."""
+    """QueryResult → aligned text table (only the shown rows are decoded)."""
     names = result.relation.names()
+    shown_n = min(result.num_rows, max_rows)
     cols = {}
     for n in names:
-        vals = result.decoded(n)
+        arr = result.columns[n][:shown_n]
+        d = result.dictionaries.get(n)
+        vals = d.decode(arr) if d is not None else arr.tolist()
         fmt = _formatter(n)
         if fmt is not None:
             try:
@@ -63,7 +66,7 @@ def render_table(result, max_rows: int = 40) -> str:
                 pass
         cols[n] = ["" if v is None else str(v) for v in vals]
     n_rows = result.num_rows
-    shown = min(n_rows, max_rows)
+    shown = shown_n
     widths = {
         n: max(len(n), *(len(cols[n][i]) for i in range(shown))) if shown else len(n)
         for n in names

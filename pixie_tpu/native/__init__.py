@@ -1,0 +1,3 @@
+from pixie_tpu.native.build import load_native
+
+__all__ = ["load_native"]

@@ -1,0 +1,75 @@
+"""Build + load the native runtime library (ctypes, no pybind11).
+
+`native/*.cc` compiles lazily into `native/libpixie_native.so` with g++ on
+first use; loading is cached.  Everything native-backed has a pure-Python
+fallback, so a missing toolchain degrades performance, never correctness
+(set PIXIE_TPU_NO_NATIVE=1 to force the fallback).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+_SRC_DIR = _REPO / "native"
+_SO_PATH = _SRC_DIR / "libpixie_native.so"
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    srcs = sorted(_SRC_DIR.glob("*.cc"))
+    if not srcs:
+        return False
+    if _SO_PATH.exists():
+        newest = max(s.stat().st_mtime for s in srcs)
+        if _SO_PATH.stat().st_mtime >= newest:
+            return True
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(_SO_PATH),
+        *[str(s) for s in srcs],
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def load_native():
+    """ctypes handle to the native library, or None (fallback mode)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PIXIE_TPU_NO_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(str(_SO_PATH))
+        except OSError:
+            return None
+        lib.px_dict_new.restype = ctypes.c_void_p
+        lib.px_dict_free.argtypes = [ctypes.c_void_p]
+        lib.px_dict_size.argtypes = [ctypes.c_void_p]
+        lib.px_dict_size.restype = ctypes.c_int64
+        lib.px_dict_encode_ucs4.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.px_dict_encode_ucs4.restype = ctypes.c_int64
+        lib.px_dict_insert_ucs4.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
+        lib.px_dict_insert_ucs4.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
