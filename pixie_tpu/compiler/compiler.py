@@ -255,7 +255,7 @@ def compile_pxl(
         )
 
     plan = optimize(ctx.plan, default_limit=default_limit)
-    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks], now=ctx.now)
+    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")], now=ctx.now)
 
 
 def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> CompiledQuery:
@@ -273,4 +273,4 @@ def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> 
     if not ctx.sinks:
         raise CompilerError("build fn produced no sink")
     plan = optimize(ctx.plan)
-    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks], now=ctx.now)
+    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")], now=ctx.now)

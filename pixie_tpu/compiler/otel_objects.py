@@ -1,0 +1,171 @@
+"""px.otel compile-time objects (reference src/carnot/planner/objects/otel.cc:
+Data/metric.Gauge/metric.Summary/trace.Span/Endpoint QLObjects that lower to
+the planpb OTelExportSink operator).
+
+Column references are DataFrame Scalars (plain Column exprs) or column-name
+strings; names not present in the DataFrame become literal attribute values.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from pixie_tpu.compiler.pxl import DataFrame, Scalar
+from pixie_tpu.plan.plan import Column
+from pixie_tpu.status import CompilerError
+
+
+def _colname(v, df: DataFrame, what: str) -> str:
+    if isinstance(v, Scalar):
+        if not isinstance(v.expr, Column):
+            raise CompilerError(
+                f"otel {what}: must be a plain column reference "
+                "(assign the expression to a column first)"
+            )
+        return v.expr.name
+    if isinstance(v, str) and v in df._schema:
+        return v
+    raise CompilerError(f"otel {what}: {v!r} is not a column of the DataFrame")
+
+
+def _attr_specs(attributes: Optional[dict], df: DataFrame) -> list[dict]:
+    out = []
+    for name, v in (attributes or {}).items():
+        if isinstance(v, Scalar) or (isinstance(v, str) and v in df._schema):
+            out.append({"name": name, "column": _colname(v, df, f"attribute {name}")})
+        else:
+            out.append({"name": name, "value": v})
+    return out
+
+
+@dataclasses.dataclass
+class Endpoint:
+    url: str
+    headers: Optional[dict] = None
+    insecure: bool = False
+    timeout: float = 5.0
+
+    def to_config(self) -> dict:
+        return {"url": self.url, "headers": dict(self.headers or {}),
+                "insecure": self.insecure, "timeout": self.timeout}
+
+
+@dataclasses.dataclass
+class Gauge:
+    name: str
+    value: object  # Scalar | column name
+    description: str = ""
+    unit: str = ""
+    attributes: Optional[dict] = None
+
+    def to_config(self, df: DataFrame, time_col: str) -> dict:
+        return {
+            "name": self.name, "description": self.description, "unit": self.unit,
+            "time_column": time_col,
+            "attributes": _attr_specs(self.attributes, df),
+            "gauge": {"value_column": _colname(self.value, df, f"gauge {self.name}")},
+        }
+
+
+@dataclasses.dataclass
+class Summary:
+    name: str
+    count: object
+    quantile_values: dict = dataclasses.field(default_factory=dict)
+    sum: object = None  # noqa: A003
+    description: str = ""
+    unit: str = ""
+    attributes: Optional[dict] = None
+
+    def to_config(self, df: DataFrame, time_col: str) -> dict:
+        return {
+            "name": self.name, "description": self.description, "unit": self.unit,
+            "time_column": time_col,
+            "attributes": _attr_specs(self.attributes, df),
+            "summary": {
+                "count_column": _colname(self.count, df, f"summary {self.name} count"),
+                "sum_column": (
+                    _colname(self.sum, df, f"summary {self.name} sum")
+                    if self.sum is not None else None
+                ),
+                "quantiles": [
+                    {"q": float(q), "column": _colname(c, df, f"summary {self.name} q{q}")}
+                    for q, c in self.quantile_values.items()
+                ],
+            },
+        }
+
+
+@dataclasses.dataclass
+class Span:
+    name: object  # str literal | Scalar column
+    start_time: object = "time_"
+    end_time: object = "end_time"
+    trace_id: object = None
+    span_id: object = None
+    parent_span_id: object = None
+    attributes: Optional[dict] = None
+
+    def to_config(self, df: DataFrame) -> dict:
+        cfg: dict = {
+            "start_time_column": _colname(self.start_time, df, "span start_time"),
+            "end_time_column": _colname(self.end_time, df, "span end_time"),
+            "attributes": _attr_specs(self.attributes, df),
+        }
+        if isinstance(self.name, Scalar):
+            cfg["name_column"] = _colname(self.name, df, "span name")
+        else:
+            cfg["name"] = str(self.name)
+        for field, key in (("trace_id", "trace_id_column"),
+                           ("span_id", "span_id_column"),
+                           ("parent_span_id", "parent_span_id_column")):
+            v = getattr(self, field)
+            if v is not None:
+                cfg[key] = _colname(v, df, f"span {field}")
+        return cfg
+
+
+@dataclasses.dataclass
+class OTelData:
+    resource: dict
+    data: list
+    endpoint: Optional[Endpoint] = None
+
+    def to_config(self, df: DataFrame) -> dict:
+        resource = {}
+        for name, v in (self.resource or {}).items():
+            if isinstance(v, Scalar) or (isinstance(v, str) and v in df._schema):
+                resource[name] = {"column": _colname(v, df, f"resource {name}")}
+            else:
+                resource[name] = v
+        metrics, spans = [], []
+        for item in self.data:
+            if isinstance(item, (Gauge, Summary)):
+                tc = "time_" if "time_" in df._schema else None
+                if tc is None:
+                    raise CompilerError("otel metrics need a time_ column")
+                metrics.append(item.to_config(df, tc))
+            elif isinstance(item, Span):
+                spans.append(item.to_config(df))
+            else:
+                raise CompilerError(f"px.otel.Data: unsupported item {item!r}")
+        cfg: dict = {"resource": resource, "metrics": metrics, "spans": spans}
+        if self.endpoint is not None:
+            cfg["endpoint"] = self.endpoint.to_config()
+        return cfg
+
+
+class _MetricNS:
+    Gauge = Gauge
+    Summary = Summary
+
+
+class _TraceNS:
+    Span = Span
+
+
+class OTelNamespace:
+    metric = _MetricNS()
+    trace = _TraceNS()
+    Data = OTelData
+    Endpoint = Endpoint

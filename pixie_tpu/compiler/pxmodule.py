@@ -174,6 +174,28 @@ class PxModule(types.ModuleType):
     def vis(self):  # pragma: no cover - placeholder namespace
         raise CompilerError("px.vis is declarative; use the vis.json spec")
 
+    # ------------------------------------------------------------ otel export
+    @property
+    def otel(self):
+        from pixie_tpu.compiler.otel_objects import OTelNamespace
+
+        return OTelNamespace()
+
+    def export(self, df: DataFrame, data) -> None:
+        """px.export(df, px.otel.Data(...)) — attach an OTel export sink
+        (reference objects/otel.cc export objects → planpb OTelExportSink)."""
+        from pixie_tpu.compiler.otel_objects import OTelData
+        from pixie_tpu.plan.plan import OTelExportSinkOp
+
+        if not isinstance(df, DataFrame):
+            raise CompilerError("px.export takes a DataFrame first")
+        if not isinstance(data, OTelData):
+            raise CompilerError("px.export second arg must be px.otel.Data(...)")
+        config = data.to_config(df)
+        sink = OTelExportSinkOp(config=config)
+        self._ctx.plan.add(sink, parents=[df._node])
+        self._ctx.sinks.append(sink)
+
     def normalize_mysql(self, q, cmd=None):
         """2-arg form (reference sql_ops.cc NormalizeMySQLUDF) takes the int
         command code column; normalization yields the JSON query-struct.  The

@@ -45,13 +45,14 @@ from pixie_tpu.plan.plan import (
     MapOp,
     MemorySinkOp,
     MemorySourceOp,
+    OTelExportSinkOp,
     Plan,
     RemoteSourceOp,
     ResultSinkOp,
     UDTFSourceOp,
     UnionOp,
 )
-from pixie_tpu.status import CompilerError, Internal, Unimplemented
+from pixie_tpu.status import CompilerError, Internal, InvalidArgument, Unimplemented
 from pixie_tpu.table.dictionary import Dictionary
 from pixie_tpu.types import STORAGE_DTYPE, ColumnSchema, DataType as DT, Relation
 
@@ -503,7 +504,8 @@ def _first_len(cols: dict) -> int:
 
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
-                 mesh="auto", analyze: bool = False, udtf_ctx=None):
+                 mesh="auto", analyze: bool = False, udtf_ctx=None,
+                 otel_exporter=None):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -525,6 +527,9 @@ class PlanExecutor:
         #: ambient state for UDTF sources (udf.udtf.UDTFContext); None builds
         #: a local-view context on demand.
         self.udtf_ctx = udtf_ctx
+        #: override transport for OTel export sinks (tests inject a collector;
+        #: None resolves from each sink's endpoint config).
+        self.otel_exporter = otel_exporter
         # Device mesh for SPMD aggregation: every unlimited agg shards its
         # feeds over all local devices and merges state with in-program
         # collectives (the reference's per-PEM fan-out + Kelvin merge becomes
@@ -600,6 +605,15 @@ class PlanExecutor:
         """
         if isinstance(head, MemorySourceOp):
             table = self.store.table(head.table)
+            if head.tablet is not None:
+                from pixie_tpu.table.tablets import TabletsGroup
+
+                if not isinstance(table, TabletsGroup):
+                    raise InvalidArgument(
+                        f"table {head.table!r} is not tabletized (tablet="
+                        f"{head.tablet!r} requested)"
+                    )
+                table = table.tablet(head.tablet)
             if head.since_row_id is not None or head.stop_row_id is not None:
                 cursor = table.cursor_since(
                     head.since_row_id or 0, head.stop_row_id,
@@ -1618,11 +1632,40 @@ class PlanExecutor:
             return self._eval_blocking(head)
         return self._consume_to_batch(parent)
 
+    # ------------------------------------------------------------------- otel
+    def _run_otel_sink(self, sink: OTelExportSinkOp) -> None:
+        """Export parent rows as OTLP (reference exec/otel_export_sink_node.*)."""
+        from pixie_tpu.engine.otel import batch_to_otlp, make_exporter
+
+        parent = self.plan.parents(sink)[0]
+        hb = self._materialize_parent(parent)
+        with self._timed("otel_export", [sink.id]) as rec:
+            payload = batch_to_otlp(hb, sink.config)
+            export = make_exporter(sink.config, self.otel_exporter)
+            export(payload)
+            n_metrics = sum(
+                len(m["gauge"]["dataPoints"] if "gauge" in m else m["summary"]["dataPoints"])
+                for rm in payload.get("resourceMetrics", [])
+                for sm in rm["scopeMetrics"]
+                for m in sm["metrics"]
+            )
+            n_spans = sum(
+                len(ss["spans"])
+                for rs in payload.get("resourceSpans", [])
+                for ss in rs["scopeSpans"]
+            )
+            rec["rows_out"] = hb.num_rows
+            self.stats["otel_datapoints"] = self.stats.get("otel_datapoints", 0) + n_metrics
+            self.stats["otel_spans"] = self.stats.get("otel_spans", 0) + n_spans
+
     # -------------------------------------------------------------------- run
     def run(self) -> dict[str, QueryResult]:
         results = {}
         t0 = _time.perf_counter_ns()
         for sink in self.plan.sinks():
+            if isinstance(sink, OTelExportSinkOp):
+                self._run_otel_sink(sink)
+                continue
             if not isinstance(sink, MemorySinkOp):
                 raise Internal(f"plan sink {sink.kind} is not a MemorySink")
             parent = self.plan.parents(sink)[0]

@@ -1,0 +1,190 @@
+"""OTel export: HostBatch → OTLP/JSON payloads.
+
+Reference: src/carnot/exec/otel_export_sink_node.* converts result row batches
+into OTLP ResourceMetrics/ResourceSpans and ships them over gRPC to a
+collector (the plugin/retention export path).  Here the conversion targets the
+OTLP/JSON encoding (opentelemetry-proto JSON mapping) and the transport is a
+pluggable callable — default: OTLP/HTTP POST via urllib; tests inject an
+in-process collector.
+"""
+from __future__ import annotations
+
+import json
+import secrets
+from typing import Callable, Optional
+
+import numpy as np
+
+from pixie_tpu.status import CompilerError
+
+
+def _attr_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, (int, np.integer)):
+        return {"intValue": str(int(v))}
+    if isinstance(v, (float, np.floating)):
+        return {"doubleValue": float(v)}
+    return {"stringValue": "" if v is None else str(v)}
+
+
+def _col(hb, name: str):
+    """Decoded python-value column from a HostBatch."""
+    if name not in hb.cols:
+        raise CompilerError(f"otel export: column {name!r} not in input "
+                            f"(have {sorted(hb.cols)})")
+    arr = hb.cols[name]
+    d = hb.dicts.get(name)
+    return d.decode(arr) if d is not None else arr.tolist()
+
+
+def _attributes(hb, specs, row: int, cache: dict) -> list:
+    out = []
+    for spec in specs or []:
+        name = spec["name"]
+        if "column" in spec:
+            col = cache.setdefault(spec["column"], _col(hb, spec["column"]))
+            out.append({"key": name, "value": _attr_value(col[row])})
+        else:
+            out.append({"key": name, "value": _attr_value(spec.get("value"))})
+    return out
+
+
+def _resource(hb, spec: dict) -> dict:
+    attrs = []
+    for name, v in (spec or {}).items():
+        if isinstance(v, dict) and "column" in v:
+            col = _col(hb, v["column"])
+            # resource attrs must be row-invariant; take the first row
+            attrs.append({"key": name, "value": _attr_value(col[0] if col else None)})
+        else:
+            attrs.append({"key": name, "value": _attr_value(v)})
+    return {"attributes": attrs}
+
+
+def batch_to_otlp(hb, config: dict) -> dict:
+    """One HostBatch → {"resourceMetrics": [...], "resourceSpans": [...]}."""
+    n = hb.num_rows
+    out: dict = {}
+    cache: dict = {}
+    resource = _resource(hb, config.get("resource"))  # computed once
+
+    metrics_cfg = config.get("metrics") or []
+    if metrics_cfg:
+        metrics = []
+        for m in metrics_cfg:
+            times = _col(hb, m["time_column"])
+            dps = []
+            for i in range(n):
+                dp = {
+                    "timeUnixNano": str(int(times[i])),
+                    "attributes": _attributes(hb, m.get("attributes"), i, cache),
+                }
+                if "gauge" in m:
+                    vals = cache.setdefault(
+                        m["gauge"]["value_column"], _col(hb, m["gauge"]["value_column"])
+                    )
+                    v = vals[i]
+                    if isinstance(v, (int, np.integer)):
+                        dp["asInt"] = str(int(v))
+                    else:
+                        dp["asDouble"] = float(v)
+                else:
+                    s = m["summary"]
+                    counts = cache.setdefault(s["count_column"], _col(hb, s["count_column"]))
+                    dp["count"] = str(int(counts[i]))
+                    if s.get("sum_column"):
+                        sums = cache.setdefault(s["sum_column"], _col(hb, s["sum_column"]))
+                        dp["sum"] = float(sums[i])
+                    dp["quantileValues"] = [
+                        {
+                            "quantile": float(qv["q"]),
+                            "value": float(
+                                cache.setdefault(qv["column"], _col(hb, qv["column"]))[i]
+                            ),
+                        }
+                        for qv in s.get("quantiles", [])
+                    ]
+                dps.append(dp)
+            body = {"name": m["name"], "description": m.get("description", ""),
+                    "unit": m.get("unit", "")}
+            if "gauge" in m:
+                body["gauge"] = {"dataPoints": dps}
+            else:
+                body["summary"] = {"dataPoints": dps}
+            metrics.append(body)
+        out["resourceMetrics"] = [{
+            "resource": resource,
+            "scopeMetrics": [{"scope": {"name": "pixie_tpu"}, "metrics": metrics}],
+        }]
+
+    spans_cfg = config.get("spans") or []
+    if spans_cfg:
+        spans = []
+        for s in spans_cfg:
+            names = (
+                cache.setdefault(s["name_column"], _col(hb, s["name_column"]))
+                if "name_column" in s
+                else None
+            )
+            t0 = _col(hb, s["start_time_column"])
+            t1 = _col(hb, s["end_time_column"])
+            tid = _col(hb, s["trace_id_column"]) if s.get("trace_id_column") else None
+            sid = _col(hb, s["span_id_column"]) if s.get("span_id_column") else None
+            pid = (
+                _col(hb, s["parent_span_id_column"])
+                if s.get("parent_span_id_column")
+                else None
+            )
+            for i in range(n):
+                spans.append({
+                    "name": names[i] if names is not None else s.get("name", "span"),
+                    # reference: auto-generate ids when the column is absent or
+                    # the value empty (plan.proto OTelSpan trace_id semantics)
+                    "traceId": (tid[i] if tid and tid[i] else secrets.token_hex(16)),
+                    "spanId": (sid[i] if sid and sid[i] else secrets.token_hex(8)),
+                    **({"parentSpanId": pid[i]} if pid and pid[i] else {}),
+                    "startTimeUnixNano": str(int(t0[i])),
+                    "endTimeUnixNano": str(int(t1[i])),
+                    "attributes": _attributes(hb, s.get("attributes"), i, cache),
+                })
+        out["resourceSpans"] = [{
+            "resource": resource,
+            "scopeSpans": [{"scope": {"name": "pixie_tpu"}, "spans": spans}],
+        }]
+    return out
+
+
+def http_exporter(endpoint: dict) -> Callable[[dict], None]:
+    """OTLP/HTTP JSON exporter (collector's /v1/metrics + /v1/traces)."""
+    import urllib.request
+
+    url = endpoint["url"].rstrip("/")
+    headers = {"Content-Type": "application/json", **(endpoint.get("headers") or {})}
+
+    def export(payload: dict) -> None:
+        for key, path in (("resourceMetrics", "/v1/metrics"),
+                          ("resourceSpans", "/v1/traces")):
+            if key not in payload:
+                continue
+            req = urllib.request.Request(
+                url + path, data=json.dumps({key: payload[key]}).encode(),
+                headers=headers, method="POST",
+            )
+            with urllib.request.urlopen(
+                req, timeout=float(endpoint.get("timeout", 5.0))
+            ) as resp:
+                resp.read()
+
+    return export
+
+
+def make_exporter(config: dict, override: Optional[Callable] = None) -> Callable[[dict], None]:
+    if override is not None:
+        return override
+    ep = config.get("endpoint")
+    if ep and ep.get("url"):
+        return http_exporter(ep)
+    # collect-only default (no endpoint configured): drop — the executor
+    # records counts in exec stats either way.
+    return lambda payload: None

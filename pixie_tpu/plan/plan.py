@@ -115,6 +115,9 @@ class MemorySourceOp(Operator):
     streaming: bool = False
     since_row_id: Optional[int] = None
     stop_row_id: Optional[int] = None
+    #: tablet id for tabletized tables (reference planpb
+    #: MemorySourceOperator.Tablet, plan.proto:149-168)
+    tablet: Optional[str] = None
 
     def _fields(self):
         return {
@@ -125,6 +128,7 @@ class MemorySourceOp(Operator):
             "streaming": self.streaming,
             "since_row_id": self.since_row_id,
             "stop_row_id": self.stop_row_id,
+            "tablet": self.tablet,
         }
 
 
@@ -245,6 +249,31 @@ class UnionOp(Operator):
 
     def _fields(self):
         return {}
+
+
+@dataclasses.dataclass
+class OTelExportSinkOp(Operator):
+    """Export parent rows as OTLP metrics/spans (reference
+    exec/otel_export_sink_node.*, planpb OTelExportSinkOperator
+    plan.proto:358-490 — column NAMES here instead of indices).
+
+    config = {
+      "endpoint": {"url": str, "headers": {..}} | None (collect-only),
+      "resource": {attr: {"column": name} | literal},
+      "metrics": [{name, description?, unit?, time_column,
+                   attributes: [{name, column}],
+                   gauge: {"value_column": c} |
+                   summary: {count_column, sum_column?,
+                             quantiles: [{"q": f, "column": c}]}}],
+      "spans": [{name | name_column, start_time_column, end_time_column,
+                 trace_id_column?, span_id_column?, parent_span_id_column?,
+                 attributes: [{name, column}]}],
+    }"""
+
+    config: dict = dataclasses.field(default_factory=dict)
+
+    def _fields(self):
+        return {"config": self.config}
 
 
 @dataclasses.dataclass
@@ -378,6 +407,7 @@ def _op_from_dict(d: dict):
             streaming=d.get("streaming", False),
             since_row_id=d.get("since_row_id"),
             stop_row_id=d.get("stop_row_id"),
+            tablet=d.get("tablet"),
         )
     if k == "map":
         return MapOp(exprs=[(n, expr_from_dict(e)) for n, e in d["exprs"]])
@@ -406,6 +436,8 @@ def _op_from_dict(d: dict):
         return UnionOp()
     if k == "udtfsource":
         return UDTFSourceOp(name=d["name"], args=dict(d["args"]), schema=d["schema"])
+    if k == "otelexportsink":
+        return OTelExportSinkOp(config=dict(d["config"]))
     if k == "resultsink":
         return ResultSinkOp(channel=d["channel"], payload=d["payload"])
     if k == "remotesource":

@@ -149,15 +149,22 @@ class Dictionary:
     def encode(self, values: Sequence) -> np.ndarray:
         """Vectorized encode of a batch of values → int32 codes.
 
-        Fast path: numpy 'U' string arrays go through the native C++ index
-        (native/dictionary.cc) — one ctypes call, zero copies.  Fallback
-        (object arrays, tuples, no toolchain): O(rows) inverse mapping plus a
+        Fast path: numpy 'U' string ARRAYS go through the native C++ index
+        (native/dictionary.cc) — one ctypes call, zero copies.  A 'U' array
+        cannot hold trailing-NUL values (numpy treats NULs as cell padding),
+        so native and fallback codes are identical by construction.  Python
+        lists stay on the fallback: converting them would silently trim
+        trailing NULs and diverge from the object path.  Fallback (lists,
+        object arrays, tuples, no toolchain): O(rows) inverse mapping plus a
         Python loop over *unique* values only (np.unique first).
         """
-        asarr = np.asarray(values) if not isinstance(values, np.ndarray) else values
-        if asarr.dtype.kind == "U" and asarr.ndim == 1:
+        if (
+            isinstance(values, np.ndarray)
+            and values.dtype.kind == "U"
+            and values.ndim == 1
+        ):
             with self._lock:
-                codes = self._encode_native_locked(asarr)
+                codes = self._encode_native_locked(values)
             if codes is not None:
                 return codes
         arr = np.asarray(values, dtype=object)

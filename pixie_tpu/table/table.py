@@ -331,11 +331,18 @@ class TableStore:
         self._tables: dict[str, Table] = {}
         self._lock = threading.Lock()
 
-    def create(self, name: str, relation: Relation, **kw) -> Table:
+    def create(self, name: str, relation: Relation, tablet_col: str | None = None, **kw):
+        """Create a Table, or a TabletsGroup when tablet_col is given
+        (reference TabletsGroup, table/tablets_group.h:34-56)."""
         with self._lock:
             if name in self._tables:
                 raise InvalidArgument(f"table {name} already exists")
-            t = Table(name, relation, **kw)
+            if tablet_col is not None:
+                from pixie_tpu.table.tablets import TabletsGroup
+
+                t = TabletsGroup(name, relation, tablet_col, **kw)
+            else:
+                t = Table(name, relation, **kw)
             self._tables[name] = t
             return t
 

@@ -88,11 +88,22 @@ def test_tuples_stay_on_fallback(lib):
     assert d.decode(np.array([0, 1, 2])) == [(1, 2), "a", "b"]
 
 
-def test_list_of_str_takes_native_path(lib):
+def test_list_of_str_stays_on_fallback(lib):
+    """Lists are NOT converted to 'U' for the native path — the conversion
+    would silently trim trailing NULs and diverge from the object fallback."""
     d = Dictionary()
     codes = d.encode(["p", "q", "p"])
     assert codes.tolist() == [0, 1, 0]
-    assert d._nd is not None
+    assert d._nd is None
+    # parity for list batches containing trailing-NUL values
+    d2 = Dictionary()
+    codes = d2.encode(["a\x00", "a"])
+    assert codes.tolist() == [0, 1]
+    assert d2.values() == ["a\x00", "a"]
+    # mixed/ragged object batches don't crash
+    d3 = Dictionary()
+    c3 = d3.encode([(1, 2), (3, 4, 5)])
+    assert c3.tolist() == [0, 1]
 
 
 def test_table_ingest_uses_native(lib):

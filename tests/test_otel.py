@@ -1,0 +1,123 @@
+"""OTel export sink: PxL surface → OTLP/JSON payloads.
+
+Reference: exec/otel_export_sink_node.*, planpb plan.proto:358-490, and the
+planner's px.otel export objects (objects/otel.cc).
+"""
+import numpy as np
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine.executor import PlanExecutor
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+SEC = 1_000_000_000
+
+
+def _store():
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING),
+        ("latency", DT.FLOAT64), ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=1024)
+    t.write({
+        "time_": np.arange(100, dtype=np.int64) * SEC,
+        "service": (["a", "b"] * 50),
+        "latency": np.linspace(1.0, 2.0, 100),
+        "status": np.full(100, 200),
+    })
+    return ts
+
+
+SCRIPT = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.rolling('10s').agg(
+    throughput=('latency', px.count),
+    p50=('latency', px.p50),
+    p99=('latency', px.p99),
+)
+df.end_time = df.time_ + 10 * 1000 * 1000 * 1000
+px.export(df, px.otel.Data(
+    resource={'service.name': 'pixie-export', 'k8s.cluster.name': 'demo'},
+    data=[
+        px.otel.metric.Gauge(name='http.throughput', value=df.throughput,
+                             attributes={'window': 'ten_seconds'}),
+        px.otel.metric.Summary(
+            name='http.latency', count=df.throughput,
+            quantile_values={0.5: df.p50, 0.99: df.p99},
+        ),
+        px.otel.trace.Span(name='http.window', start_time=df.time_,
+                           end_time=df.end_time),
+    ],
+))
+"""
+
+
+def test_otel_export_payload():
+    ts = _store()
+    q = compile_pxl(SCRIPT, ts.schemas(), now=200 * SEC)
+    captured = []
+    ex = PlanExecutor(q.plan, ts, otel_exporter=captured.append)
+    res = ex.run()
+    assert res == {}  # export-only plan: no client tables
+    assert len(captured) == 1
+    payload = captured[0]
+
+    rms = payload["resourceMetrics"]
+    res_attrs = {a["key"]: a["value"] for a in rms[0]["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "pixie-export"}
+    metrics = {m["name"]: m for m in rms[0]["scopeMetrics"][0]["metrics"]}
+    assert set(metrics) == {"http.throughput", "http.latency"}
+    gauge_dps = metrics["http.throughput"]["gauge"]["dataPoints"]
+    assert len(gauge_dps) == 10  # 100s of data in 10s windows
+    assert sum(int(dp["asInt"]) for dp in gauge_dps) == 100
+    assert gauge_dps[0]["attributes"][0]["key"] == "window"
+    summ_dps = metrics["http.latency"]["summary"]["dataPoints"]
+    qs = {qv["quantile"] for qv in summ_dps[0]["quantileValues"]}
+    assert qs == {0.5, 0.99}
+
+    spans = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(spans) == 10
+    s0 = spans[0]
+    assert s0["name"] == "http.window"
+    assert len(s0["traceId"]) == 32 and len(s0["spanId"]) == 16  # auto ids
+    assert int(s0["endTimeUnixNano"]) - int(s0["startTimeUnixNano"]) == 10 * SEC
+
+    assert ex.stats["otel_datapoints"] == 20
+    assert ex.stats["otel_spans"] == 10
+
+
+def test_otel_plan_serialization_roundtrip():
+    from pixie_tpu.plan.plan import Plan
+
+    ts = _store()
+    q = compile_pxl(SCRIPT, ts.schemas(), now=200 * SEC)
+    p2 = Plan.from_dict(q.plan.to_dict())
+    captured = []
+    PlanExecutor(p2, ts, otel_exporter=captured.append).run()
+    assert len(captured) == 1
+
+
+def test_otel_column_attributes_and_mixed_display():
+    ts = _store()
+    script = """
+import px
+df = px.DataFrame(table='http_events')
+agg = df.groupby('service').agg(cnt=('latency', px.count))
+agg.time_ = px.now() * 1
+px.export(agg, px.otel.Data(
+    resource={'service.name': agg.service},
+    data=[px.otel.metric.Gauge(name='req.count', value=agg.cnt,
+                               attributes={'service': agg.service})],
+))
+px.display(agg, 'also_table')
+"""
+    q = compile_pxl(script, ts.schemas(), now=200 * SEC)
+    captured = []
+    res = PlanExecutor(q.plan, ts, otel_exporter=captured.append).run()
+    assert "also_table" in res and res["also_table"].num_rows == 2
+    dps = captured[0]["resourceMetrics"][0]["scopeMetrics"][0]["metrics"][0][
+        "gauge"]["dataPoints"]
+    svc_attrs = {dp["attributes"][0]["value"]["stringValue"] for dp in dps}
+    assert svc_attrs == {"a", "b"}
