@@ -137,12 +137,16 @@ def cmd_run(args) -> int:
         from pixie_tpu.collect.schemas import all_schemas
         from pixie_tpu.compiler import compile_pxl
         from pixie_tpu.engine import execute_plan
+        from pixie_tpu.services.tracepoints import TracepointManager
 
         store, now = _demo_cluster()
         schemas = {**all_schemas(), **store.schemas()}
+        tp_mgr = TracepointManager(store)
 
         def execute(fn, fargs):
             q = compile_pxl(source, schemas, func=fn, func_args=fargs, now=now)
+            if q.mutations:
+                tp_mgr.apply(q.mutations)
             return execute_plan(q.plan, store, analyze=args.analyze)
 
     kinds = vis.widget_kinds() if vis is not None else {}

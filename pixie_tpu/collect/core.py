@@ -173,6 +173,14 @@ class Collector:
             rows += n
             if self.on_push is not None:
                 self.on_push(table_name, n)
+            if n:
+                from pixie_tpu import metrics as _metrics
+
+                _metrics.counter_inc(
+                    "px_collector_rows_pushed_total", n,
+                    labels={"table": table_name},
+                    help_="rows pushed into the table store by connectors",
+                )
         self.stats["transfers"] += 1
         self.stats["rows_pushed"] += rows
         return rows

@@ -51,8 +51,12 @@ def _safe_builtins(px_module) -> dict:
     def _import(name, globals=None, locals=None, fromlist=(), level=0):
         if name == "px":
             return px_module
+        if name == "pxtrace":
+            from pixie_tpu.compiler.pxtrace import PxTraceModule
+
+            return PxTraceModule(px_module._ctx)
         raise ImportError(
-            f"PxL scripts may only `import px` (attempted {name!r})"
+            f"PxL scripts may only import px / pxtrace (attempted {name!r})"
         )
 
     out = {n: getattr(_b, n) for n in _SAFE_BUILTIN_NAMES if hasattr(_b, n)}
@@ -181,8 +185,8 @@ def validate_pxl_source(source: str) -> ast.Module:
         if isinstance(node, ast.FunctionDef):
             if node.decorator_list:
                 raise CompilerError("PxL does not allow decorators")
-        if isinstance(node, ast.alias) and node.name != "px":
-            raise CompilerError("PxL scripts may only `import px`")
+        if isinstance(node, ast.alias) and node.name not in ("px", "pxtrace"):
+            raise CompilerError("PxL scripts may only import px / pxtrace")
     return tree
 
 
@@ -191,6 +195,9 @@ class CompiledQuery:
     plan: Plan
     sink_names: list[str]
     now: int
+    #: tracepoint deployments the caller must apply before/with execution
+    #: (reference: CompileMutations → MutationExecutor, mutation_executor.go:84)
+    mutations: list = dataclasses.field(default_factory=list)
 
 
 def _coerce_arg(value, annotation):
@@ -255,7 +262,9 @@ def compile_pxl(
         )
 
     plan = optimize(ctx.plan, default_limit=default_limit)
-    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")], now=ctx.now)
+    return CompiledQuery(plan=plan,
+                         sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")],
+                         now=ctx.now, mutations=list(ctx.mutations))
 
 
 def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> CompiledQuery:
@@ -273,4 +282,6 @@ def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> 
     if not ctx.sinks:
         raise CompilerError("build fn produced no sink")
     plan = optimize(ctx.plan)
-    return CompiledQuery(plan=plan, sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")], now=ctx.now)
+    return CompiledQuery(plan=plan,
+                         sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")],
+                         now=ctx.now, mutations=list(ctx.mutations))

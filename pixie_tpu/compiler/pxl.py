@@ -41,10 +41,12 @@ class CompileCtx:
 
     def __init__(self, schemas: dict[str, Relation], registry, now: int):
         self.plan = Plan()
-        self.schemas = schemas
+        self.schemas = dict(schemas)  # pxtrace may add probe output tables
         self.registry = registry
         self.now = now
         self.sinks: list[MemorySinkOp] = []
+        #: tracepoint deployments etc. (reference CompileMutations path)
+        self.mutations: list[dict] = []
 
     # ------------------------------------------------------------------ types
     def infer_type(self, fn: str, arg_dtypes: list[DT]) -> DT:

@@ -1,0 +1,108 @@
+"""pxtrace compile-time module: dynamic tracepoint deployment from PxL.
+
+Reference: src/carnot/planner/probes/ (tracing_module.cc, tracepoint_
+generator.cc) compiles `pxtrace` calls into TracepointDeployment protos that
+the mutation executor ships to agents (mutation_executor.go:84), which compile
+bpftrace/BCC programs and materialize new tables
+(src/stirling/source_connectors/dynamic_tracer/).
+
+This build keeps the full compile→deploy→table lifecycle; the kernel probe
+attachment itself is host-specific and pluggable (services.tracepoints
+TracepointManager accepts a probe driver; without one, deployed tables fill
+from whatever producer is wired — the test/simulation path — matching the
+reference behavior of an empty table until the probe fires).
+
+Output schemas derive from the bpftrace program's printf format string —
+`printf("time_:%llu pid:%u src_ip:%s ...")` — exactly the information the
+reference's bpftrace wrapper uses to declare the output table.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import types
+
+from pixie_tpu.compiler import timeparse
+from pixie_tpu.status import CompilerError
+from pixie_tpu.types import ColumnSchema, DataType as DT, Relation
+
+_PRINTF_RE = re.compile(r'printf\(\s*"((?:[^"\\]|\\.)*)"', re.S)
+_FIELD_RE = re.compile(r"([A-Za-z_][A-Za-z_0-9]*):%([a-z]+)")
+
+_FMT_TYPES = {
+    "llu": DT.INT64, "lu": DT.INT64, "u": DT.INT64, "d": DT.INT64,
+    "ld": DT.INT64, "lld": DT.INT64, "x": DT.INT64, "llx": DT.INT64,
+    "s": DT.STRING, "f": DT.FLOAT64,
+}
+
+
+def parse_program_schema(program: str) -> Relation:
+    """Output relation from the program's printf format fields."""
+    m = _PRINTF_RE.search(program)
+    if not m:
+        raise CompilerError(
+            "pxtrace program has no printf(...) — cannot derive the output schema"
+        )
+    fmt = m.group(1)
+    cols = []
+    for name, spec in _FIELD_RE.findall(fmt):
+        dt = _FMT_TYPES.get(spec)
+        if dt is None:
+            raise CompilerError(f"pxtrace: unsupported printf spec %{spec} for {name}")
+        if name == "time_":
+            dt = DT.TIME64NS
+        cols.append(ColumnSchema(name, dt))
+    if not cols:
+        raise CompilerError("pxtrace printf format defines no `name:%spec` fields")
+    return Relation(cols)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProbeSpec:
+    kind: str  # kprobe | uprobe | tracepoint
+
+
+class PxTraceModule(types.ModuleType):
+    """The `pxtrace` module instance injected per compilation."""
+
+    def __init__(self, ctx):
+        super().__init__("pxtrace", "PxL dynamic tracing module (TPU build)")
+        self._ctx = ctx
+
+    # probe type constructors (reference tracing_module.cc kprobe/uprobe)
+    def kprobe(self) -> ProbeSpec:
+        return ProbeSpec("kprobe")
+
+    def uprobe(self) -> ProbeSpec:
+        return ProbeSpec("uprobe")
+
+    def tracepoint(self) -> ProbeSpec:
+        return ProbeSpec("tracepoint")
+
+    def UpsertTracepoint(self, name: str, table_name: str, program: str,
+                         probe, ttl: str) -> None:
+        """Compile a tracepoint deployment (reference UpsertTracepoint →
+        TracepointDeployment).  Side effects at compile time:
+        the parsed output schema becomes queryable (px.DataFrame(table=...))
+        and the deployment spec lands in CompiledQuery.mutations."""
+        if not isinstance(probe, ProbeSpec):
+            raise CompilerError(
+                "UpsertTracepoint: probe must be pxtrace.kprobe()/uprobe()/tracepoint()"
+            )
+        rel = parse_program_schema(program)
+        ttl_ns = timeparse.parse_duration_ns(ttl) if isinstance(ttl, str) else int(ttl)
+        if ttl_ns <= 0:
+            raise CompilerError("UpsertTracepoint: ttl must be positive")
+        self._ctx.schemas[table_name] = rel
+        self._ctx.mutations.append({
+            "kind": "tracepoint",
+            "name": name,
+            "table_name": table_name,
+            "program": program,
+            "probe": probe.kind,
+            "ttl_ns": ttl_ns,
+            "schema": rel.to_dict(),
+        })
+
+    def DeleteTracepoint(self, name: str) -> None:
+        self._ctx.mutations.append({"kind": "delete_tracepoint", "name": name})

@@ -75,12 +75,16 @@ class GroupKeyFallback(Unimplemented):
     path (SURVEY §7 hard parts; reference capability: exec/agg_node.h's hash
     map has no cardinality bound)."""
 MIN_BUCKET = 1 << 10
+from pixie_tpu import flags as _flags
+
 #: Feed coalescing target: sealed storage batches (64K-ish, the reference's
 #: compaction granularity) are merged into large device feeds so a typical
 #: query is ONE device execution.  Sized at 16M rows (~0.5 GB at 32 B/row)
 #: because on remote/tunneled runtimes each execution has a large fixed cost —
 #: fewer, bigger launches win decisively over streaming many small batches.
-FEED_ROWS = 1 << 24
+FEED_ROWS = _flags.define_int(
+    "PX_FEED_ROWS", 1 << 24, "feed coalescing target (rows per device feed)"
+)
 
 
 # -------------------------------------------------------------- kernel cache
@@ -151,11 +155,12 @@ def _chain_uses_volatile(chain, registry) -> bool:
 # feeds are cached in HBM keyed by the seal gens.  Repeat queries then stream
 # ZERO bytes host→device — essential when the chip is remote (tunneled PCIe/DCN
 # transfers run at ~100 MB/s and would dominate every query).
-import os as _os
-
 _DEVICE_CACHE: "_collections.OrderedDict[tuple, dict]" = _collections.OrderedDict()
 _DEVICE_CACHE_BYTES = 0
-_DEVICE_CACHE_MAX = int(_os.environ.get("PIXIE_TPU_DEVICE_CACHE_MB", "4096")) << 20
+_DEVICE_CACHE_MAX = _flags.define_int(
+    "PIXIE_TPU_DEVICE_CACHE_MB", 4096,
+    "HBM feed cache budget (MB); the PEM table-memory-budget analog",
+) << 20
 
 
 def _device_cache_get(key):

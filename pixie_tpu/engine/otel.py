@@ -161,6 +161,11 @@ def http_exporter(endpoint: dict) -> Callable[[dict], None]:
 
     url = endpoint["url"].rstrip("/")
     headers = {"Content-Type": "application/json", **(endpoint.get("headers") or {})}
+    ssl_ctx = None
+    if endpoint.get("insecure"):
+        import ssl
+
+        ssl_ctx = ssl._create_unverified_context()
 
     def export(payload: dict) -> None:
         for key, path in (("resourceMetrics", "/v1/metrics"),
@@ -172,7 +177,7 @@ def http_exporter(endpoint: dict) -> Callable[[dict], None]:
                 headers=headers, method="POST",
             )
             with urllib.request.urlopen(
-                req, timeout=float(endpoint.get("timeout", 5.0))
+                req, timeout=float(endpoint.get("timeout", 5.0)), context=ssl_ctx
             ) as resp:
                 resp.read()
 

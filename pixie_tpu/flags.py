@@ -1,0 +1,108 @@
+"""Flag system: declared, typed, env-overridable configuration.
+
+Reference: C++ gflags with PL_* env fallbacks
+(gflags::Int32FromEnv("PL_TABLE_STORE_DATA_LIMIT_MB", 1280),
+src/vizier/services/agent/pem/pem_manager.cc:24-35) and the Go side's
+pflag+viper (src/shared/services/service_flags.go).
+
+Usage:
+    from pixie_tpu import flags
+    FEED_ROWS = flags.define_int("PX_FEED_ROWS", 1 << 24, "feed coalescing")
+    ... flags.get("PX_FEED_ROWS") ...
+Values resolve env var > default; `flags.dump()` lists everything for
+debugging/ops (the --help analog).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Callable, Optional
+
+from pixie_tpu.status import InvalidArgument
+
+
+@dataclasses.dataclass
+class Flag:
+    name: str
+    default: object
+    parse: Callable
+    help: str = ""  # noqa: A003
+    value: object = None
+    from_env: bool = False
+
+
+_registry: dict[str, Flag] = {}
+_lock = threading.Lock()
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _define(name: str, default, parse, help_: str):
+    with _lock:
+        f = _registry.get(name)
+        if f is not None:
+            if f.default != default:
+                raise InvalidArgument(
+                    f"flag {name} redefined with different default"
+                )
+            return f.value
+        raw = os.environ.get(name)
+        value = parse(raw) if raw is not None else default
+        _registry[name] = Flag(name, default, parse, help_, value, raw is not None)
+        return value
+
+
+def define_int(name: str, default: int, help_: str = "") -> int:
+    return _define(name, int(default), int, help_)
+
+
+def define_float(name: str, default: float, help_: str = "") -> float:
+    return _define(name, float(default), float, help_)
+
+
+def define_str(name: str, default: str, help_: str = "") -> str:
+    return _define(name, str(default), str, help_)
+
+
+def define_bool(name: str, default: bool, help_: str = "") -> bool:
+    return _define(name, bool(default), _parse_bool, help_)
+
+
+def get(name: str):
+    f = _registry.get(name)
+    if f is None:
+        raise InvalidArgument(f"unknown flag {name!r}")
+    return f.value
+
+
+def set_for_testing(name: str, value) -> None:
+    """Override in-process (tests/ops tooling)."""
+    f = _registry.get(name)
+    if f is None:
+        raise InvalidArgument(f"unknown flag {name!r}")
+    f.value = f.parse(str(value)) if not isinstance(value, type(f.default)) else value
+
+
+def dump() -> dict[str, dict]:
+    """Every declared flag with value/default/source (ops introspection)."""
+    with _lock:
+        return {
+            name: {
+                "value": f.value,
+                "default": f.default,
+                "from_env": f.from_env,
+                "help": f.help,
+            }
+            for name, f in sorted(_registry.items())
+        }
+
+
+def reset_for_testing(name: Optional[str] = None) -> None:
+    with _lock:
+        if name is None:
+            _registry.clear()
+        else:
+            _registry.pop(name, None)

@@ -1,0 +1,132 @@
+"""Self-observability: Prometheus-text metrics registry + structured logging.
+
+Reference: the C++ Prometheus registry (src/common/metrics/metrics.h), per-table
+gauges (table/table_metrics.h), and the Go services' /metrics endpoints
+(src/shared/services/metrics/).  Services expose `render()` over their
+transport ({"msg": "metrics"} on the broker) and anything in-process can
+scrape via the module API.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+_lock = threading.Lock()
+_counters: dict[tuple, float] = {}
+_gauges: dict[tuple, float] = {}
+_gauge_fns: dict[str, tuple[str, Callable[[], dict]]] = {}
+_help: dict[str, str] = {}
+
+
+def _key(name: str, labels: Optional[dict]) -> tuple:
+    return (name, tuple(sorted((labels or {}).items())))
+
+
+def counter_inc(name: str, value: float = 1.0, labels: Optional[dict] = None,
+                help_: str = "") -> None:
+    with _lock:
+        k = _key(name, labels)
+        _counters[k] = _counters.get(k, 0.0) + value
+        if help_:
+            _help.setdefault(name, help_)
+
+
+def gauge_set(name: str, value: float, labels: Optional[dict] = None,
+              help_: str = "") -> None:
+    with _lock:
+        _gauges[_key(name, labels)] = float(value)
+        if help_:
+            _help.setdefault(name, help_)
+
+
+def register_gauge_fn(name: str, fn: Callable[[], dict], help_: str = "") -> None:
+    """Lazy gauge: fn() -> {labels-tuple-or-frozen-dict: value} evaluated at
+    render time (per-table sizes, registry liveness, ...)."""
+    with _lock:
+        _gauge_fns[name] = (help_, fn)
+
+
+def unregister_gauge_fn(name: str) -> None:
+    """Drop a lazy gauge (service shutdown — keeps the module-global registry
+    from pinning dead objects alive)."""
+    with _lock:
+        _gauge_fns.pop(name, None)
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def render() -> str:
+    """Prometheus text exposition of everything registered."""
+    lines = []
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        gauge_fns = dict(_gauge_fns)
+        helps = dict(_help)
+    seen = set()
+    for (name, labels), v in sorted(counters.items()):
+        if name not in seen:
+            seen.add(name)
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name}{_fmt_labels(labels)} {v:g}")
+    for (name, labels), v in sorted(gauges.items()):
+        if name not in seen:
+            seen.add(name)
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{_fmt_labels(labels)} {v:g}")
+    for name, (help_, fn) in sorted(gauge_fns.items()):
+        try:
+            vals = fn()
+        except Exception:
+            continue
+        if name not in seen:
+            seen.add(name)
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+        for labels, v in sorted(vals.items()):
+            lt = labels if isinstance(labels, tuple) else tuple(sorted(labels.items()))
+            lines.append(f"{name}{_fmt_labels(lt)} {v:g}")
+    return "\n".join(lines) + "\n"
+
+
+def reset_for_testing() -> None:
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _gauge_fns.clear()
+        _help.clear()
+
+
+# ------------------------------------------------------------------- logging
+
+
+def log(level: str, msg: str, **fields) -> None:
+    """Structured log line (glog/logrus analog): level, ts, msg, k=v fields."""
+    import json
+    import sys
+
+    rec = {"ts": time.time(), "level": level, "msg": msg, **fields}
+    print(json.dumps(rec), file=sys.stderr, flush=True)
+
+
+def info(msg: str, **fields) -> None:
+    log("info", msg, **fields)
+
+
+def warn(msg: str, **fields) -> None:
+    log("warn", msg, **fields)
+
+
+def error(msg: str, **fields) -> None:
+    log("error", msg, **fields)

@@ -80,6 +80,8 @@ class LocalCluster:
         )
         self.spec = ClusterSpec(agents)
         self.planner = DistributedPlanner(self.spec)
+        #: per-agent tracepoint managers (created on first mutation)
+        self._tp_managers: dict = {}
 
     def schemas(self) -> dict:
         return self.spec.combined_schemas()
@@ -115,7 +117,24 @@ class LocalCluster:
 
         q = compile_pxl(pxl_source, self.schemas(), func=func, func_args=func_args,
                         now=now, default_limit=default_limit)
+        if q.mutations:
+            self.apply_mutations(q.mutations)
         return self.execute(q.plan, analyze=analyze)
+
+    def apply_mutations(self, mutations: list) -> None:
+        """Deploy tracepoints on every data agent and refresh the planner's
+        schema view (reference: MutationExecutor → agents' TracepointManager,
+        then the query waits for schema readiness)."""
+        from pixie_tpu.services.tracepoints import TracepointManager
+
+        for name, store in self.stores.items():
+            mgr = self._tp_managers.get(name)
+            if mgr is None:
+                mgr = self._tp_managers[name] = TracepointManager(store)
+            mgr.apply(mutations)
+        for a in self.spec.agents:
+            if a.name in self.stores:
+                a.schemas = self.stores[a.name].schemas()
 
     def execute(self, logical: Plan, analyze: bool = False) -> dict[str, QueryResult]:
         dp = self.planner.plan(logical)
@@ -156,6 +175,9 @@ class LocalCluster:
                           udtf_ctx=UDTFContext(
                               table_store=self.merger_store, registry=reg,
                               schema_catalog=self.schemas(),
+                              tracepoint_manager=next(
+                                  iter(self._tp_managers.values()), None
+                              ),
                           ))
         results = ex.run()
         # Per-agent exec stats ride along with every result (reference:

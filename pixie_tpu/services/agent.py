@@ -46,6 +46,11 @@ class Agent:
         self._registered = threading.Event()
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        from pixie_tpu.services.tracepoints import TracepointManager
+
+        #: dynamic tracepoints deployed to this agent (pem TracepointManager
+        #: analog, pem/tracepoint_manager.h:48)
+        self.tracepoints = TracepointManager(self.store)
 
     # ---------------------------------------------------------------- lifecycle
     def start(self, timeout: float = 10.0) -> "Agent":
@@ -98,6 +103,21 @@ class Agent:
                 target=self._execute, args=(payload,), daemon=True,
                 name=f"pixie-agent-exec-{self.name}",
             ).start()
+        elif msg == "deploy_tracepoint":
+            try:
+                self.tracepoints.apply([payload["spec"]])
+                # schemas changed: re-register BEFORE acking so the broker's
+                # registry sees the new table when the ack lands
+                self._register()
+                self.conn.send(wire.encode_json({
+                    "msg": "tracepoint_ready", "req_id": payload.get("req_id"),
+                    "agent": self.name,
+                }))
+            except Exception as e:
+                self.conn.send(wire.encode_json({
+                    "msg": "tracepoint_error", "req_id": payload.get("req_id"),
+                    "agent": self.name, "error": str(e),
+                }))
 
     def _execute(self, meta: dict):
         req_id = meta.get("req_id", "")

@@ -58,6 +58,20 @@ class Broker:
         self.udf_registry = registry
         self.query_timeout_s = query_timeout_s
         self.merger_store = TableStore()
+        from pixie_tpu.services.tracepoints import TracepointManager
+
+        #: cluster-level tracepoint registry (metadata-service analog:
+        #: persisted in the control KV, surfaced by GetTracepointStatus)
+        self.tracepoints = TracepointManager(self.merger_store, kv=self.kv)
+        from pixie_tpu.services.cron import CronScriptRunner
+
+        #: cron scripts (reference script_runner.go:47-54), persisted in kv
+        self.cron = CronScriptRunner(
+            lambda script, func, func_args: self.execute_script(
+                script, func=func, func_args=func_args
+            )[0],
+            kv=self.kv,
+        )
         self._server = Server(host, port, self._on_frame, self._on_close)
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
@@ -74,13 +88,25 @@ class Broker:
         return self._server.port
 
     def start(self) -> "Broker":
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.register_gauge_fn(
+            "px_broker_live_agents",
+            lambda: {(): float(len(self.registry.live_agents()))},
+            "agents currently live in the registry",
+        )
         self._server.start()
         self._expiry_thread.start()
+        self.cron.start()
         return self
 
     def stop(self):
+        from pixie_tpu import metrics as _metrics
+
         self._stopped.set()
+        self.cron.stop()
         self._server.stop()
+        _metrics.unregister_gauge_fn("px_broker_live_agents")
         self.kv.close()
 
     def _expiry_loop(self):
@@ -107,6 +133,13 @@ class Broker:
             elif msg == "heartbeat":
                 if not self.registry.heartbeat(payload["agent"]):
                     conn.send(wire.encode_json({"msg": "reregister"}))
+            elif msg == "tracepoint_ready":
+                self._handle_exec_done({
+                    "req_id": payload.get("req_id"),
+                    "agent": payload.get("agent"), "stats": {},
+                })
+            elif msg == "tracepoint_error":
+                self._handle_exec_error(payload)
             elif msg == "exec_done":
                 self._handle_exec_done(payload)
             elif msg == "exec_error":
@@ -115,6 +148,44 @@ class Broker:
                 threading.Thread(
                     target=self._run_query, args=(conn, payload), daemon=True
                 ).start()
+            elif msg == "metrics":
+                from pixie_tpu import metrics as _metrics
+
+                conn.send(wire.encode_json({
+                    "msg": "metrics_text",
+                    "req_id": payload.get("req_id"),
+                    "text": _metrics.render(),
+                }))
+            elif msg == "flags":
+                from pixie_tpu import flags as _flags
+
+                conn.send(wire.encode_json({
+                    "msg": "flags_dump",
+                    "req_id": payload.get("req_id"),
+                    "flags": _flags.dump(),
+                }))
+            elif msg == "cron_upsert":
+                self._reply_ack(conn, payload, lambda: self.cron.upsert(
+                    payload["name"], payload["script"],
+                    payload.get("interval_s", 60.0),
+                    func=payload.get("func"),
+                    func_args=payload.get("func_args"),
+                ))
+            elif msg == "cron_delete":
+                self._reply_ack(
+                    conn, payload, lambda: self.cron.delete(payload["name"])
+                )
+            elif msg == "cron_list":
+                conn.send(wire.encode_json({
+                    "msg": "cron_scripts", "req_id": payload.get("req_id"),
+                    "scripts": [
+                        {"name": c.name, "interval_s": c.interval_s,
+                         "enabled": c.enabled, "run_count": c.run_count,
+                         "error_count": c.error_count,
+                         "last_error": c.last_error}
+                        for c in self.cron.list()
+                    ],
+                }))
             elif msg == "list_schemas":
                 conn.send(wire.encode_json({
                     "msg": "schemas",
@@ -130,6 +201,17 @@ class Broker:
             # data chunk from an agent (host_batch | partial_agg)
             meta = payload.wire_meta
             self._handle_chunk(meta, payload)
+
+    @staticmethod
+    def _reply_ack(conn: Connection, payload: dict, fn) -> None:
+        """Run a control action; reply {msg: ok} or the error envelope."""
+        try:
+            fn()
+            conn.send(wire.encode_json({"msg": "ok", "req_id": payload.get("req_id")}))
+        except Exception as e:
+            conn.send(wire.encode_json({
+                "msg": "error", "req_id": payload.get("req_id"), "error": str(e),
+            }))
 
     def _on_close(self, conn: Connection):
         name = conn.state.get("agent")
@@ -213,11 +295,64 @@ class Broker:
                 {"msg": "error", "req_id": req_id, "error": str(e)}
             ))
 
+    def _deploy_mutations(self, mutations: list) -> None:
+        from pixie_tpu.status import Unavailable
+
+        specs = [
+            m for m in mutations
+            if m.get("kind") in ("tracepoint", "delete_tracepoint")
+        ]
+        targets = {
+            name: conn for name, conn in self._agent_conns.items()
+            if not conn.closed
+        }
+        if not specs or not targets:
+            return
+        with self._qlock:
+            self._req_counter += 1
+            rid = f"tp{self._req_counter}"
+            ctx = _QueryCtx(set(targets), set())
+            # one ack per (agent, spec); track by counting agents per spec round
+            self._queries[rid] = ctx
+        try:
+            for spec in specs:
+                ctx.pending_agents = set(targets)
+                ctx.done.clear()
+                for conn in targets.values():
+                    conn.send(wire.encode_json({
+                        "msg": "deploy_tracepoint", "req_id": rid, "spec": spec,
+                    }))
+                if not ctx.done.wait(timeout=self.query_timeout_s):
+                    raise Unavailable(
+                        f"tracepoint deploy timed out on {sorted(ctx.pending_agents)}"
+                    )
+                if ctx.error:
+                    raise Unavailable(ctx.error)
+        finally:
+            with self._qlock:
+                self._queries.pop(rid, None)
+
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False,
     ) -> tuple[dict[str, QueryResult], dict]:
         """Compile + distribute + merge (the in-process core of ExecuteScript)."""
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.counter_inc("px_broker_queries_total",
+                             help_="ExecuteScript requests served")
+        try:
+            return self._execute_script_inner(
+                script, func, func_args, now, default_limit, analyze
+            )
+        except Exception:
+            _metrics.counter_inc("px_broker_query_errors_total",
+                                 help_="ExecuteScript requests that failed")
+            raise
+
+    def _execute_script_inner(
+        self, script, func, func_args, now, default_limit, analyze,
+    ) -> tuple[dict[str, QueryResult], dict]:
         from pixie_tpu.compiler import compile_pxl
         from pixie_tpu.parallel.cluster import _union_host_batches
         from pixie_tpu.status import Internal, Unavailable
@@ -230,6 +365,13 @@ class Broker:
             func_args=func_args, registry=self.udf_registry, now=now,
             default_limit=default_limit,
         )
+        if q.mutations:
+            # Deploy tracepoints to every live agent and wait for readiness
+            # (reference MutationExecutor: register → agents deploy → poll
+            # isSchemaReady, mutation_executor.go:84,272).
+            self.tracepoints.apply(q.mutations)
+            self._deploy_mutations(q.mutations)
+            spec = self.registry.cluster_spec()  # schemas refreshed by re-register
         dp = DistributedPlanner(spec).plan(q.plan)
 
         with self._qlock:
@@ -279,6 +421,7 @@ class Broker:
                 udtf_ctx=UDTFContext(
                     table_store=self.merger_store, registry=reg,
                     agent_registry=self.registry,
+                    tracepoint_manager=self.tracepoints,
                 ),
             )
             results = ex.run()

@@ -1,0 +1,141 @@
+"""Tracepoint lifecycle: registry + deployment of dynamic-trace tables.
+
+Reference: the metadata service's tracepoint controller persists and
+reconciles tracepoints (src/vizier/services/metadata/controllers/tracepoint/),
+agents' TracepointManager deploys them into Stirling
+(pem/tracepoint_manager.h:48) which compiles the program and materializes a
+new table (source_connectors/dynamic_tracer/).
+
+Here deployment = create the probe's output table in the agent's store and
+track state/TTL; the probe ATTACHMENT is pluggable via `probe_driver` (a
+callable receiving the spec + table) because kernel eBPF is host-specific —
+without a driver the table simply stays empty until a producer writes it,
+which is also the reference's observable behavior pre-attach.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from pixie_tpu.status import NotFound
+from pixie_tpu.types import Relation
+
+
+@dataclasses.dataclass
+class TracepointInfo:
+    name: str
+    table_name: str
+    program: str
+    probe: str
+    ttl_ns: int
+    created_ns: int
+    state: str = "running"  # pending | running | terminated | failed
+    status: str = ""
+
+    def expires_ns(self) -> int:
+        return self.created_ns + self.ttl_ns
+
+
+class TracepointManager:
+    """Deployed-tracepoint registry for one store (agent or library use)."""
+
+    def __init__(self, store, kv=None,
+                 probe_driver: Optional[Callable] = None):
+        self.store = store
+        self.kv = kv
+        self.probe_driver = probe_driver
+        self._tps: dict[str, TracepointInfo] = {}
+        self._lock = threading.Lock()
+        if kv is not None:
+            import json
+
+            for _k, raw in kv.scan("tracepoint/"):
+                d = json.loads(raw.decode())
+                self._tps[d["name"]] = TracepointInfo(**d)
+
+    # ------------------------------------------------------------- lifecycle
+    def upsert(self, spec: dict, now_ns: Optional[int] = None) -> TracepointInfo:
+        """Deploy (or refresh) a tracepoint: create its output table and mark
+        it running (reference UpsertTracepoint semantics: same-name upsert
+        refreshes the TTL)."""
+        now = now_ns if now_ns is not None else time.time_ns()
+        rel = Relation.from_dict(spec["schema"])
+        with self._lock:
+            tp = self._tps.get(spec["name"])
+            if tp is None:
+                tp = TracepointInfo(
+                    name=spec["name"], table_name=spec["table_name"],
+                    program=spec["program"], probe=spec.get("probe", "kprobe"),
+                    ttl_ns=int(spec["ttl_ns"]), created_ns=now,
+                )
+                self._tps[tp.name] = tp
+            else:
+                tp.created_ns = now  # TTL refresh
+                tp.ttl_ns = int(spec["ttl_ns"])
+                tp.state = "running"
+            if self.store.has(tp.table_name):
+                # Redeploy with a CHANGED program/schema replaces the table —
+                # the compiling side already sees the new relation, so keeping
+                # the old one would desync schema and data (in-memory
+                # telemetry is droppable by design).
+                if self.store.table(tp.table_name).relation != rel:
+                    self.store.drop(tp.table_name)
+                    self.store.create(tp.table_name, rel)
+            else:
+                self.store.create(tp.table_name, rel)
+            if self.probe_driver is not None:
+                try:
+                    self.probe_driver(spec, self.store.table(tp.table_name))
+                except Exception as e:
+                    tp.state = "failed"
+                    tp.status = str(e)
+            self._persist(tp)
+            return tp
+
+    def delete(self, name: str) -> None:
+        with self._lock:
+            tp = self._tps.get(name)
+            if tp is None:
+                raise NotFound(f"no tracepoint {name!r}")
+            tp.state = "terminated"
+            self._persist(tp)
+
+    def expire(self, now_ns: Optional[int] = None) -> list[str]:
+        """TTL sweep: running tracepoints past their TTL terminate (the
+        reference's reconciliation loop)."""
+        now = now_ns if now_ns is not None else time.time_ns()
+        out = []
+        with self._lock:
+            for tp in self._tps.values():
+                if tp.state == "running" and now >= tp.expires_ns():
+                    tp.state = "terminated"
+                    tp.status = "ttl expired"
+                    self._persist(tp)
+                    out.append(tp.name)
+        return out
+
+    def apply(self, mutations: list[dict]) -> list[TracepointInfo]:
+        """Apply a CompiledQuery.mutations list.  Deleting an unknown
+        tracepoint is a no-op (agents may never have seen it)."""
+        out = []
+        for m in mutations:
+            if m.get("kind") == "tracepoint":
+                out.append(self.upsert(m))
+            elif m.get("kind") == "delete_tracepoint":
+                try:
+                    self.delete(m["name"])
+                except NotFound:
+                    pass
+        return out
+
+    # ----------------------------------------------------------------- views
+    def list(self) -> list[TracepointInfo]:  # noqa: A003
+        self.expire()
+        with self._lock:
+            return sorted(self._tps.values(), key=lambda t: t.name)
+
+    def _persist(self, tp: TracepointInfo) -> None:
+        if self.kv is not None:
+            self.kv.set_json(f"tracepoint/{tp.name}", dataclasses.asdict(tp))

@@ -67,15 +67,28 @@ def encode_json(meta: dict) -> bytes:
     return _frame("json", meta, [])
 
 
+def _u128_jsonable(v):
+    from pixie_tpu.types import UInt128
+
+    if v is None:
+        return None
+    if isinstance(v, UInt128):
+        return [v.high, v.low]
+    return list(v)
+
+
 def _dict_values_jsonable(d: Dictionary, dt: DT) -> list:
     if dt == DT.UINT128:
-        return [list(v) if v is not None else None for v in d.values()]
+        return [_u128_jsonable(v) for v in d.values()]
     return d.values()
 
 
 def _dict_values_restore(vals: list, dt: DT) -> list:
     if dt == DT.UINT128:
-        return [tuple(v) if v is not None else None for v in vals]
+        from pixie_tpu.types import UInt128
+
+        # canonical in-memory form is UInt128 (metadata UDFs read .high/.pid)
+        return [UInt128(*v) if v is not None else None for v in vals]
     return vals
 
 
@@ -104,7 +117,7 @@ def encode_partial_agg(pb, extra_meta: dict | None = None) -> bytes:
         if arr.dtype == object:
             if dt == DT.UINT128:
                 key_meta[name] = {
-                    "jsonvals": [list(v) if v is not None else None for v in arr.tolist()]
+                    "jsonvals": [_u128_jsonable(v) for v in arr.tolist()]
                 }
             else:
                 key_meta[name] = {"jsonvals": arr.tolist()}

@@ -350,6 +350,10 @@ class TableStore:
         with self._lock:
             self._tables[table.name] = table
 
+    def drop(self, name: str) -> None:
+        with self._lock:
+            self._tables.pop(name, None)
+
     def table(self, name: str) -> Table:
         t = self._tables.get(name)
         if t is None:

@@ -30,6 +30,8 @@ class UDTFContext:
     agent_registry: object = None
     #: static schema catalog fallback when no live agents ship schemas
     schema_catalog: Optional[dict] = None
+    #: services.tracepoints.TracepointManager when dynamic tracing is wired
+    tracepoint_manager: object = None
     asid: int = 0
     node_name: str = ""
 
@@ -162,6 +164,21 @@ def _get_debug_table_info(ctx: UDTFContext) -> dict:
     return rows
 
 
+def _get_tracepoint_status(ctx: UDTFContext) -> dict:
+    rows = {"tracepoint_id": [], "name": [], "state": [], "status": [],
+            "output_tables": [], "create_time": []}
+    mgr = ctx.tracepoint_manager
+    if mgr is not None:
+        for i, tp in enumerate(mgr.list()):
+            rows["tracepoint_id"].append((0, i))
+            rows["name"].append(tp.name)
+            rows["state"].append(tp.state)
+            rows["status"].append(tp.status)
+            rows["output_tables"].append(tp.table_name)
+            rows["create_time"].append(tp.created_ns)
+    return rows
+
+
 def register_builtin_udtfs(registry) -> None:
     """Install the introspection UDTF set (reference md_udtfs_impl.h relations,
     cited by line in SURVEY-visible comments above)."""
@@ -191,5 +208,10 @@ def register_builtin_udtfs(registry) -> None:
              Relation.of(("asid", I), ("name", S), ("id", I),
                          ("batches_added", I), ("num_batches", I),
                          ("size", I), ("min_time", T)), _get_debug_table_info),
+        # reference md_udtfs_impl.h:726 GetTracepointStatus
+        UDTF("GetTracepointStatus",
+             Relation.of(("tracepoint_id", U), ("name", S), ("state", S),
+                         ("status", S), ("output_tables", S),
+                         ("create_time", T)), _get_tracepoint_status),
     ]:
         registry.register_udtf(u)

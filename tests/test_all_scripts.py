@@ -27,11 +27,7 @@ from pixie_tpu.metadata.state import (
 SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
 
 #: scripts expected NOT to compile yet: {name: reason}
-XFAIL: dict[str, str] = {
-    "tracepoint_status": "GetTracepointStatus UDTF needs the dynamic-trace subsystem",
-    "tcp_drops": "pxtrace (bpftrace dynamic tracing) module",
-    "tcp_retransmits": "pxtrace (bpftrace dynamic tracing) module",
-}
+XFAIL: dict[str, str] = {}
 
 #: upstream scripts with literal syntax bugs (missing comma between agg
 #: kwargs) — invalid Python AND invalid for any PxL parser; patched here so

@@ -1,0 +1,98 @@
+"""Flag system + metrics registry (reference: gflags PL_* env fallbacks
+pem_manager.cc:24-35; Prometheus registry common/metrics/metrics.h)."""
+import os
+
+import numpy as np
+import pytest
+
+from pixie_tpu import flags, metrics
+from pixie_tpu.status import InvalidArgument
+
+
+def test_flag_define_get_and_env(monkeypatch):
+    flags.reset_for_testing("PX_TEST_FLAG_A")
+    v = flags.define_int("PX_TEST_FLAG_A", 7, "test")
+    assert v == 7 and flags.get("PX_TEST_FLAG_A") == 7
+    # env override wins at definition time
+    flags.reset_for_testing("PX_TEST_FLAG_B")
+    monkeypatch.setenv("PX_TEST_FLAG_B", "42")
+    assert flags.define_int("PX_TEST_FLAG_B", 7) == 42
+    d = flags.dump()
+    assert d["PX_TEST_FLAG_B"]["from_env"] is True
+    assert d["PX_TEST_FLAG_B"]["value"] == 42
+    with pytest.raises(InvalidArgument):
+        flags.get("PX_NOPE")
+    flags.set_for_testing("PX_TEST_FLAG_A", 9)
+    assert flags.get("PX_TEST_FLAG_A") == 9
+    # redefinition with a different default is an error
+    with pytest.raises(InvalidArgument):
+        flags.define_int("PX_TEST_FLAG_A", 8)
+
+
+def test_flag_types(monkeypatch):
+    flags.reset_for_testing("PX_TF_BOOL")
+    monkeypatch.setenv("PX_TF_BOOL", "true")
+    assert flags.define_bool("PX_TF_BOOL", False) is True
+    flags.reset_for_testing("PX_TF_F")
+    assert flags.define_float("PX_TF_F", 1.5) == 1.5
+
+
+def test_executor_flags_registered():
+    import pixie_tpu.engine.executor  # noqa: F401  (defines them on import)
+
+    d = flags.dump()
+    assert "PX_FEED_ROWS" in d
+    assert "PIXIE_TPU_DEVICE_CACHE_MB" in d
+
+
+def test_metrics_render_counters_gauges():
+    metrics.reset_for_testing()
+    metrics.counter_inc("t_total", 2, labels={"k": "a"}, help_="help text")
+    metrics.counter_inc("t_total", 3, labels={"k": "a"})
+    metrics.counter_inc("t_total", 1, labels={"k": "b"})
+    metrics.gauge_set("t_gauge", 1.5)
+    metrics.register_gauge_fn("t_lazy", lambda: {(("x", "1"),): 9.0})
+    text = metrics.render()
+    assert '# HELP t_total help text' in text
+    assert 't_total{k="a"} 5' in text
+    assert 't_total{k="b"} 1' in text
+    assert "t_gauge 1.5" in text
+    assert 't_lazy{x="1"} 9' in text
+
+
+def test_broker_metrics_endpoint():
+    from pixie_tpu.services import wire
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    metrics.reset_for_testing()
+    broker = Broker().start()
+    ts = TableStore()
+    ts.create("t", Relation.of(("x", DT.INT64))).write({"x": np.arange(10)})
+    agent = Agent("pem1", "127.0.0.1", broker.port, store=ts).start()
+    client = Client("127.0.0.1", broker.port)
+    try:
+        client.execute_script(
+            "import px\ndf = px.DataFrame(table='t')\npx.display(df, 'o')"
+        )
+        # raw metrics request over the same transport
+        import socket
+        from pixie_tpu.services.transport import recv_frame, send_frame
+
+        s = socket.create_connection(("127.0.0.1", broker.port))
+        send_frame(s, wire.encode_json({"msg": "metrics", "req_id": "m1"}))
+        kind, payload = wire.decode_frame(recv_frame(s))
+        assert payload["msg"] == "metrics_text"
+        assert "px_broker_queries_total 1" in payload["text"]
+        assert "px_broker_live_agents 1" in payload["text"]
+        send_frame(s, wire.encode_json({"msg": "flags", "req_id": "f1"}))
+        kind, payload = wire.decode_frame(recv_frame(s))
+        assert "PX_FEED_ROWS" in payload["flags"]
+        s.close()
+    finally:
+        client.close()
+        agent.stop()
+        broker.stop()

@@ -1,0 +1,157 @@
+"""Dynamic tracing: pxtrace compile path + tracepoint lifecycle.
+
+Reference: src/carnot/planner/probes/ (pxtrace → TracepointDeployment),
+mutation_executor.go:84 (deploy + wait for schema), pem/tracepoint_manager.h,
+md_udtfs GetTracepointStatus.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.compiler.pxtrace import parse_program_schema
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.services.tracepoints import TracepointManager
+from pixie_tpu.status import CompilerError
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT
+
+PROGRAM = '''
+kprobe:tcp_drop
+{
+  printf("time_:%llu pid:%u src_ip:%s src_port:%d dst_ip:%s dst_port:%d state:%s",
+    nsecs, pid, $saddr, $sport, $daddr, $dport, $statestr);
+}
+'''
+
+SCRIPT = f'''
+import pxtrace
+import px
+
+program = """{PROGRAM}"""
+
+def drops():
+    pxtrace.UpsertTracepoint('tcp_drop_tracer', 'tcp_drop_table', program,
+                             pxtrace.kprobe(), "10m")
+    df = px.DataFrame(table='tcp_drop_table')
+    df = df.groupby(['src_ip', 'dst_ip']).agg(drops=('src_ip', px.count))
+    return df
+'''
+
+
+def test_parse_program_schema():
+    rel = parse_program_schema(PROGRAM)
+    assert rel.names() == [
+        "time_", "pid", "src_ip", "src_port", "dst_ip", "dst_port", "state",
+    ]
+    assert rel.dtype("time_") == DT.TIME64NS
+    assert rel.dtype("src_ip") == DT.STRING
+    assert rel.dtype("src_port") == DT.INT64
+    with pytest.raises(CompilerError):
+        parse_program_schema("kprobe:x { }")
+
+
+def test_compile_produces_mutation_and_queryable_schema():
+    q = compile_pxl(SCRIPT, {}, func="drops", func_args={})
+    assert len(q.mutations) == 1
+    m = q.mutations[0]
+    assert m["kind"] == "tracepoint" and m["table_name"] == "tcp_drop_table"
+    assert m["ttl_ns"] == 600 * 10**9
+    assert q.plan.sinks()
+
+
+def test_tracepoint_manager_lifecycle_and_query():
+    ts = TableStore()
+    mgr = TracepointManager(ts)
+    q = compile_pxl(SCRIPT, {}, func="drops", func_args={})
+    tps = mgr.apply(q.mutations)
+    assert tps[0].state == "running"
+    assert ts.has("tcp_drop_table")
+    # simulate the probe firing (the pluggable producer path)
+    ts.table("tcp_drop_table").write({
+        "time_": np.arange(4, dtype=np.int64),
+        "pid": np.full(4, 7),
+        "src_ip": ["10.0.0.1", "10.0.0.1", "10.0.0.2", "10.0.0.1"],
+        "src_port": np.full(4, 1000),
+        "dst_ip": ["10.0.9.9"] * 4,
+        "dst_port": np.full(4, 80),
+        "state": ["CLOSE"] * 4,
+    })
+    res = execute_plan(q.plan, ts)["output"]
+    df = res.to_pandas().sort_values("src_ip").reset_index(drop=True)
+    assert list(df["drops"]) == [3, 1]
+    # TTL refresh on upsert; expiry terminates
+    mgr.apply(q.mutations)
+    now = time.time_ns()
+    assert mgr.expire(now_ns=now) == []
+    assert mgr.expire(now_ns=now + 601 * 10**9) == ["tcp_drop_tracer"]
+    assert mgr.list()[0].state == "terminated"
+
+
+def test_get_tracepoint_status_udtf():
+    ts = TableStore()
+    mgr = TracepointManager(ts)
+    q = compile_pxl(SCRIPT, {}, func="drops", func_args={})
+    mgr.apply(q.mutations)
+    from pixie_tpu.engine.executor import PlanExecutor
+    from pixie_tpu.udf.udtf import UDTFContext
+
+    q2 = compile_pxl(
+        "import px\n"
+        "df = px.GetTracepointStatus()\n"
+        "df = df[df.state == 'running']\n"
+        "px.display(df, 'tps')\n",
+        {},
+    )
+    ctx = UDTFContext(table_store=ts, tracepoint_manager=mgr)
+    res = PlanExecutor(q2.plan, ts, udtf_ctx=ctx).run()["tps"]
+    recs = res.to_records()
+    assert len(recs) == 1
+    assert recs[0]["name"] == "tcp_drop_tracer"
+    assert recs[0]["output_tables"] == "tcp_drop_table"
+
+
+def test_broker_deploys_tracepoints_to_agents():
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client
+    from pixie_tpu.types import Relation
+
+    broker = Broker(query_timeout_s=30.0).start()
+    stores = {}
+    agents = []
+    for name in ("pem1", "pem2"):
+        ts = TableStore()
+        ts.create("seq0", Relation.of(("time_", DT.TIME64NS), ("x", DT.INT64)))
+        ts.table("seq0").write({"time_": np.arange(5, dtype=np.int64),
+                                "x": np.arange(5)})
+        stores[name] = ts
+        agents.append(Agent(name, "127.0.0.1", broker.port, store=ts,
+                            heartbeat_s=0.2).start())
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    try:
+        res = client.execute_script(SCRIPT, func="drops", func_args={})
+        # deployed everywhere, no data yet → structurally valid empty result
+        assert res["output"].num_rows == 0
+        for ts in stores.values():
+            assert ts.has("tcp_drop_table")
+        # probe fires on pem2; re-run picks the rows up
+        stores["pem2"].table("tcp_drop_table").write({
+            "time_": np.arange(2, dtype=np.int64), "pid": np.full(2, 1),
+            "src_ip": ["a", "a"], "src_port": np.zeros(2, np.int64),
+            "dst_ip": ["b", "b"], "dst_port": np.zeros(2, np.int64),
+            "state": ["CLOSE", "CLOSE"],
+        })
+        res = client.execute_script(SCRIPT, func="drops", func_args={})
+        assert res["output"].to_pandas()["drops"].sum() == 2
+        # introspection shows the tracepoint cluster-wide
+        res = client.execute_script(
+            "import px\npx.display(px.GetTracepointStatus(), 'tps')"
+        )
+        assert res["tps"].num_rows == 1
+    finally:
+        client.close()
+        for a in agents:
+            a.stop()
+        broker.stop()
