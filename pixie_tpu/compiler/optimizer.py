@@ -33,6 +33,21 @@ from pixie_tpu.plan.plan import (
 from pixie_tpu.status import CompilerError
 
 
+def _real_sinks(plan: Plan) -> list:
+    """Terminal ops that actually OUTPUT something.  Rebuilding only from
+    these drops dangling dead branches (a DataFrame built but never
+    displayed/exported — the reference's PruneUnusedOperatorsRule)."""
+    from pixie_tpu.plan.plan import OTelExportSinkOp, ResultSinkOp
+
+    out = [
+        s for s in plan.sinks()
+        if isinstance(s, (MemorySinkOp, ResultSinkOp, OTelExportSinkOp))
+    ]
+    if not out:
+        raise CompilerError("plan has no output sink")
+    return out
+
+
 def _subst(e: Expr, env: dict[str, Expr]) -> Expr:
     if isinstance(e, Column):
         return env.get(e.name, e)
@@ -77,7 +92,7 @@ def fuse_maps(plan: Plan) -> Plan:
         memo[op.id] = newop
         return newop
 
-    for sink in plan.sinks():
+    for sink in _real_sinks(plan):
         build(sink)
     return new
 
@@ -116,8 +131,21 @@ def prune_columns(plan: Plan) -> Plan:
         else:
             need[opid] = cur | req
 
+    # Requirements flow only from REACHABLE ops — a dead branch (dropped by
+    # the _real_sinks rebuild) must not widen upstream sources.
+    reachable: set[int] = set()
+    stack = list(_real_sinks(plan))
+    while stack:
+        op = stack.pop()
+        if op.id in reachable:
+            continue
+        reachable.add(op.id)
+        stack.extend(plan.parents(op))
+
     order = plan.topo_sorted()
     for op in reversed(order):
+        if op.id not in reachable:
+            continue
         my_need = need.get(op.id, set())
         parents = plan.parents(op)
         if isinstance(op, MemorySinkOp):
@@ -189,7 +217,7 @@ def prune_columns(plan: Plan) -> Plan:
         memo[op.id] = c
         return c
 
-    for sink in plan.sinks():
+    for sink in _real_sinks(plan):
         build(sink)
     return new
 
@@ -209,7 +237,7 @@ def inject_limit(plan: Plan, default_limit: int) -> Plan:
         memo[op.id] = c
         return c
 
-    for sink in plan.sinks():
+    for sink in _real_sinks(plan):
         if not isinstance(sink, MemorySinkOp):
             build(sink)
             continue

@@ -68,6 +68,18 @@ SORT_AGG_CHUNK = 1 << 20
 MIN_WINDOW_BINS = 1 << 6
 
 
+#: All-null sentinel for dict-valued pickers: equals _identity_for(int32,
+#: "min") so an all-null group's state stays at the identity and decodes null.
+PICKER_NULL_SENTINEL = np.iinfo(np.int32).max
+
+
+def _decode_picker_codes(vals, d: Dictionary) -> np.ndarray:
+    """Picker state codes → int32 dictionary codes; out-of-range (all-null
+    sentinel) becomes -1 (null)."""
+    codes = np.asarray(vals, dtype=np.int64)
+    return np.where((codes < 0) | (codes >= d.size), -1, codes).astype(np.int32)
+
+
 class GroupKeyFallback(Unimplemented):
     """Raised when group keys are not expressible as bounded dense codes
     (computed numeric keys, float keys, cardinality beyond MAX_GROUPS).
@@ -471,7 +483,12 @@ class ChainKernel:
             mask = self._base_mask(env, n, n_valid, t_lo, t_hi)
             mask, consumed = self._apply_steps(env, mask, limit_remaining)
             if keys:
-                code_arrays = [kb(env) for kb in key_builders]
+                # literal group keys (df.node = 'x') build scalar codes —
+                # broadcast to row length so the segment scatter sees [n]
+                code_arrays = [
+                    jnp.broadcast_to(c, (n,)) if c.ndim == 0 else c
+                    for c in (kb(env) for kb in key_builders)
+                ]
                 # Null keys (code -1, e.g. unmatched left-join fills) drop out
                 # of the aggregate (pandas dropna semantics); without this,
                 # combine_codes would clamp them into group 0.
@@ -992,10 +1009,11 @@ class PlanExecutor:
 
     def _run_agg(self, op: AggOp) -> HostBatch:
         try:
-            keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+            keys, udas, state_np, seen_name, in_types, val_dicts = self._agg_state(op)
         except GroupKeyFallback:
             return self._run_agg_sorted(op)
-        return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types)
+        return self._finalize_agg(op, keys, udas, state_np, seen_name, in_types,
+                                  val_dicts)
 
     # -------------------------------------------------- sort-based agg fallback
     def _sorted_group_reduce(self, op: AggOp):
@@ -1056,22 +1074,35 @@ class PlanExecutor:
 
         # ---- device reduction over exact gids, chunked.
         udas, in_types, state = [], {}, {}
+        val_dicts: dict[str, Dictionary] = {}
+        dict_val_cols: set[str] = set()
         for ae in op.values:
             uda = self.registry.uda(ae.fn)
             in_dt = None
             in_types[ae.out_name] = None
             if ae.arg is not None:
                 if ae.arg in out_dicts:
-                    raise Unimplemented(
-                        f"aggregate {ae.fn} over string column {ae.arg!r}"
-                    )
-                in_types[ae.out_name] = out_dtypes[ae.arg]
-                in_dt = STORAGE_DTYPE[out_dtypes[ae.arg]]
+                    if not uda.dict_ok:
+                        raise Unimplemented(
+                            f"aggregate {ae.fn} over string column {ae.arg!r}"
+                        )
+                    in_types[ae.out_name] = out_dtypes[ae.arg]
+                    in_dt = np.int32
+                    val_dicts[ae.out_name] = out_dicts[ae.arg]
+                    dict_val_cols.add(ae.arg)
+                else:
+                    in_types[ae.out_name] = out_dtypes[ae.arg]
+                    in_dt = STORAGE_DTYPE[out_dtypes[ae.arg]]
             elif not uda.nullary:
                 raise CompilerError(f"aggregate {ae.fn} requires an input column")
             udas.append((ae.out_name, uda, ae.arg))
             state[ae.out_name] = uda.init(Gb, in_dt)
         val_names = sorted({vn for _o, _u, vn in udas if vn is not None})
+        # null codes must never win the picker's min-reduction
+        for vn in dict_val_cols:
+            c = cols[vn]
+            cols = {**cols,
+                    vn: np.where(c >= 0, c, PICKER_NULL_SENTINEL).astype(np.int32)}
 
         # The jitted update closure is cached per (registry, agg spec, Gb):
         # jax.jit then reuses traces across calls/polls instead of recompiling
@@ -1107,12 +1138,12 @@ class PlanExecutor:
                 if self.analyze:
                     jax.block_until_ready(state)
             state_np = transfer.pull(state)
-        return group_cols, out_dtypes, out_dicts, udas, in_types, state_np, G
+        return (group_cols, out_dtypes, out_dicts, udas, in_types, state_np, G,
+                val_dicts)
 
     def _run_agg_sorted(self, op: AggOp) -> HostBatch:
-        group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G = (
-            self._sorted_group_reduce(op)
-        )
+        (group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G,
+         val_dicts) = self._sorted_group_reduce(op)
         dtypes: dict[str, DT] = {}
         dicts: dict[str, Dictionary] = {}
         cols: dict[str, np.ndarray] = {}
@@ -1125,6 +1156,11 @@ class PlanExecutor:
             full = uda.finalize_host(state_np[out_name])
             vals = np.asarray(full)[:G]
             out_dt = uda.out_type(in_types[out_name]) if not uda.nullary else uda.out_type(None)
+            if out_name in val_dicts:
+                cols[out_name] = _decode_picker_codes(vals, val_dicts[out_name])
+                dicts[out_name] = val_dicts[out_name]
+                dtypes[out_name] = out_dt
+                continue
             if out_dt == DT.STRING:
                 d = Dictionary()
                 cols[out_name] = d.encode(vals)
@@ -1139,9 +1175,13 @@ class PlanExecutor:
         state sliced to the seen groups (same wire shape as _partial_agg_batch)."""
         from pixie_tpu.parallel.partial import PartialAggBatch
 
-        group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G = (
-            self._sorted_group_reduce(op)
-        )
+        (group_cols, in_dtypes, in_dicts, udas, in_types, state_np, G,
+         val_dicts) = self._sorted_group_reduce(op)
+        if val_dicts:
+            raise Internal(
+                "dict-valued aggregates must ship rows, not partial state "
+                "(the distributed planner cuts them as rows channels)"
+            )
         key_cols, key_dtypes = {}, {}
         for g in op.groups:
             key_dtypes[g] = in_dtypes[g]
@@ -1195,8 +1235,8 @@ class PlanExecutor:
         for _attempt in range(2):
             built = self._agg_kernel(op, sig, fb_sig, dtypes, dicts, chain,
                                      time_col, visible, src, head)
-            (kern, keys, udas, in_types, init_specs, num_groups,
-             seen_name, step, partial_step, merge_fn, spmd_step) = built
+            (kern, keys, udas, in_types, init_specs, num_groups, seen_name,
+             step, partial_step, merge_fn, spmd_step, val_dicts) = built
             ok, keys, lut_over = self._refresh_window_keys(keys, src, head)
             if ok:
                 break
@@ -1222,7 +1262,7 @@ class PlanExecutor:
                 kern, step, partial_step, merge_fn, spmd_step, state,
                 src, names, cap, t_lo, t_hi, luts,
             )
-        return keys, udas, state_np, seen_name, in_types
+        return keys, udas, state_np, seen_name, in_types, val_dicts
 
     def _refresh_window_keys(self, keys, src, head):
         """Per-run window-origin resolution.
@@ -1269,6 +1309,7 @@ class PlanExecutor:
         udas = []
         init_specs = []
         seen_name = "__seen"
+        val_dicts: dict[str, Dictionary] = {}
         from pixie_tpu.udf.udf import CountUDA
 
         in_types: dict[str, DT | None] = {}
@@ -1282,10 +1323,26 @@ class PlanExecutor:
                 if sv is None:
                     raise CompilerError(f"agg input column {ae.arg!r} not found")
                 if sv.dictionary is not None:
-                    raise Unimplemented(f"aggregate {ae.fn} over string column {ae.arg!r}")
-                vb = sv.build
-                in_dtype = STORAGE_DTYPE[sv.dtype]
-                in_types[ae.out_name] = sv.dtype
+                    if not uda.dict_ok:
+                        raise Unimplemented(
+                            f"aggregate {ae.fn} over string column {ae.arg!r}"
+                        )
+                    # Dict-valued picker: aggregate over CODES (null code -1
+                    # masked to the min-identity so it never wins); the
+                    # finalize step decodes back through the dictionary.
+                    b = sv.build
+
+                    def vb(env, b=b):
+                        v = b(env)
+                        return jnp.where(v >= 0, v, jnp.int32(PICKER_NULL_SENTINEL))
+
+                    in_dtype = np.int32
+                    in_types[ae.out_name] = sv.dtype
+                    val_dicts[ae.out_name] = sv.dictionary
+                else:
+                    vb = sv.build
+                    in_dtype = STORAGE_DTYPE[sv.dtype]
+                    in_types[ae.out_name] = sv.dtype
             elif not uda.nullary:
                 raise CompilerError(f"aggregate {ae.fn} requires an input column")
             udas.append((ae.out_name, uda, vb))
@@ -1312,7 +1369,7 @@ class PlanExecutor:
                 len(kern.limit_ns), self.mesh,
             )
         bundle = (kern, keys, udas, in_types, init_specs, num_groups,
-                  seen_name, step, partial_step, merge_fn, spmd_step)
+                  seen_name, step, partial_step, merge_fn, spmd_step, val_dicts)
         _cache_put(sig, bundle)
         return bundle
 
@@ -1377,9 +1434,14 @@ class PlanExecutor:
         from pixie_tpu.parallel.partial import PartialAggBatch
 
         try:
-            keys, udas, state_np, seen_name, in_types = self._agg_state(op)
+            keys, udas, state_np, seen_name, in_types, val_dicts = self._agg_state(op)
         except GroupKeyFallback:
             return self._sorted_partial_batch(op)
+        if val_dicts:
+            raise Internal(
+                "dict-valued aggregates must ship rows, not partial state "
+                "(the distributed planner cuts them as rows channels)"
+            )
         seen_counts = np.asarray(state_np[seen_name])
         if keys:
             gids = np.nonzero(seen_counts > 0)[0]
@@ -1428,7 +1490,8 @@ class PlanExecutor:
         self.stats["operators"] = self.op_stats
         return out
 
-    def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None) -> HostBatch:
+    def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None,
+                      val_dicts=None) -> HostBatch:
         from pixie_tpu.ops.groupby import split_codes
 
         seen_counts = np.asarray(state_np[seen_name])
@@ -1467,6 +1530,13 @@ class PlanExecutor:
                 out_dt = uda.out_type(in_types[out_name])
             else:
                 out_dt = uda.out_type(_dtype_of(full))
+            if val_dicts and out_name in val_dicts:
+                # dict-valued picker: the state holds CODES; out-of-range
+                # (all-null group sentinel) decodes to null
+                cols[out_name] = _decode_picker_codes(vals, val_dicts[out_name])
+                dicts[out_name] = val_dicts[out_name]
+                dtypes[out_name] = out_dt
+                continue
             if out_dt == DT.STRING:
                 d = Dictionary()
                 cols[out_name] = d.encode(vals)

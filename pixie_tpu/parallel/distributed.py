@@ -93,8 +93,26 @@ class DistributedPlanner:
     """Splits one logical plan across a ClusterSpec (reference
     DistributedPlanner::Plan, distributed_planner.cc)."""
 
-    def __init__(self, cluster: ClusterSpec):
+    def __init__(self, cluster: ClusterSpec, registry=None):
         self.cluster = cluster
+        if registry is None:
+            from pixie_tpu.udf import registry as registry_mod
+
+            registry = registry_mod
+        self.registry = registry
+
+    def _partial_safe(self, op: AggOp) -> bool:
+        """Whether the agg's state merges across agents' private dictionary
+        code spaces.  dict_ok UDAs (any over a string column) carry CODES in
+        their state — conservative: ship rows even for numeric any()."""
+        for ae in op.values:
+            try:
+                uda = self.registry.uda(ae.fn)
+            except Exception:
+                return False
+            if uda.dict_ok:
+                return False
+        return True
 
     def plan(self, logical: Plan) -> DistributedPlan:
         merger = self.cluster.merger()
@@ -207,6 +225,7 @@ class DistributedPlanner:
                 # distributed aggregate.  Ship rows; the merger re-applies
                 # the limit, then aggregates exactly n rows.
                 and min_limit[parents[0].id] == _INF
+                and self._partial_safe(op)
             ):
                 cut_agg(op, parents[0])
                 continue

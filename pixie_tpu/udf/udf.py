@@ -74,6 +74,11 @@ class UDA:
     name: str = "?"
     #: True if the UDA takes no value column (count).
     nullary: bool = False
+    #: True if the UDA may consume a dictionary-encoded (STRING/UINT128)
+    #: column: its update sees the CODES; the executor decodes at finalize.
+    #: Only order-insensitive pickers qualify (any) — min/max over codes
+    #: would not be lexical order.
+    dict_ok: bool = False
 
     def out_type(self, in_type: DataType | None) -> DataType:
         raise NotImplementedError
@@ -279,6 +284,7 @@ class AnyUDA(UDA):
     'first-seen', is order-independent across shards/batches."""
 
     name = "any"
+    dict_ok = True
 
     def out_type(self, in_type):
         return in_type

@@ -1,0 +1,113 @@
+"""Sampling profiler connector → stack_traces.beta → flamegraph query.
+
+Reference: src/stirling/source_connectors/perf_profiler/ (sample continuously,
+push periodically, folded stacks + counts).
+"""
+import threading
+import time
+
+import numpy as np
+
+from pixie_tpu.collect.core import Collector
+from pixie_tpu.collect.perf_profiler import PerfProfilerConnector, fold_stack
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+
+
+def busy_marker_function(stop):
+    x = 0
+    while not stop.is_set():
+        x = (x + 1) % 1000003
+    return x
+
+
+def test_fold_stack_shape():
+    import sys
+
+    f = sys._getframe()
+    s = fold_stack(f)
+    assert "test_perf_profiler.test_fold_stack_shape" in s
+    assert ";" in s or s.count(".") >= 1  # root-first chain
+
+
+def test_profiler_samples_busy_thread_and_feeds_table():
+    stop = threading.Event()
+    worker = threading.Thread(target=busy_marker_function, args=(stop,),
+                              name="busy-marker")
+    worker.start()
+    collector = Collector()
+    prof = PerfProfilerConnector(hz=200.0, push_period_s=0.5)
+    collector.register(prof)
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and prof.samples_taken < 50:
+            time.sleep(0.05)
+        assert prof.samples_taken >= 50
+        collector.transfer_once()
+    finally:
+        stop.set()
+        worker.join()
+        collector.stop()
+    t = collector.store.table("stack_traces.beta")
+    assert t.stats()["rows_written"] > 0
+
+    # the busy thread's function dominates the samples
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='stack_traces.beta')\n"
+        "df = df.groupby('stack_trace').agg(cnt=('count', px.sum))\n"
+        "px.display(df, 'flame')\n",
+        collector.store.schemas(),
+    )
+    res = execute_plan(q.plan, collector.store)["flame"]
+    df = res.to_pandas()
+    marked = df[df.stack_trace.str.contains("busy_marker_function")]
+    assert not marked.empty
+    # absolute bound, not a share: under a loaded test process other daemon
+    # threads (collectors, brokers from earlier tests) also get sampled
+    assert marked["cnt"].sum() >= 20
+
+
+def test_perf_flamegraph_script_runs_on_profiler_data():
+    """The bundled perf_flamegraph script executes over real profiler rows."""
+    import json
+    import pathlib
+
+    import tests.test_all_scripts as harness
+    from pixie_tpu.collect.schemas import all_schemas
+    from pixie_tpu.metadata.state import (
+        MetadataStateManager, global_manager, set_global_manager,
+    )
+    from pixie_tpu.testing import demo_metadata
+
+    old = global_manager()
+    mgr, _, _ = demo_metadata()
+    set_global_manager(mgr)
+    try:
+        collector = Collector()
+        prof = PerfProfilerConnector(hz=200.0, push_period_s=0.1)
+        collector.register(prof)
+        stop = threading.Event()
+        worker = threading.Thread(target=busy_marker_function, args=(stop,))
+        worker.start()
+        time.sleep(0.5)
+        collector.transfer_once()
+        stop.set()
+        worker.join()
+        collector.stop()
+
+        d = pathlib.Path("/root/reference/src/pxl_scripts/px/perf_flamegraph")
+        src = harness._source_of(d)
+        vis = json.loads((d / "vis.json").read_text())
+        funcs = harness._funcs_to_compile(vis)
+        schemas = {**all_schemas(), **collector.store.schemas()}
+        now = time.time_ns()
+        ran = 0
+        for fname, fargs in funcs:
+            q = compile_pxl(src, schemas, func=fname, func_args=fargs, now=now)
+            res = execute_plan(q.plan, collector.store)
+            assert set(res) == set(q.sink_names)
+            ran += 1
+        assert ran >= 1
+    finally:
+        set_global_manager(old)

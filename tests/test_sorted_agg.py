@@ -226,6 +226,94 @@ px.display(df, 'out')
     np.testing.assert_allclose(got["s"], want["s"], rtol=1e-9)
 
 
+def test_any_over_string_column_device_and_sorted_paths():
+    """px.any over a dict-encoded column: state carries codes, finalize
+    decodes; exercised on BOTH the dense path and the sorted fallback."""
+    rng = np.random.default_rng(21)
+    n = 8_000
+    ids = rng.integers(0, 50, n)
+    vals = rng.exponential(1.0, n)
+    svc = np.array([f"svc-{i % 5}" for i in ids])
+    ts = _mkstore(n, ids, vals, extra=[("svc", DT.STRING, svc)])
+    # dense path: raw int key
+    p = _agg_plan(["id"], [AggExpr("s", "any", "svc"), AggExpr("cnt", "count", None)])
+    got = execute_plan(p, ts)["out"].to_pandas().sort_values("id").reset_index(drop=True)
+    want = (
+        pd.DataFrame({"id": ids, "svc": svc})
+        .groupby("id").agg(s=("svc", "first"), cnt=("svc", "size")).reset_index()
+    )
+    # any == SOME value of the group; with id→svc functional it's exact
+    assert (got["s"].to_numpy() == want["s"].to_numpy()).all()
+    assert (got["cnt"].to_numpy() == want["cnt"].to_numpy()).all()
+    # sorted fallback: computed key
+    p2 = _agg_plan(
+        ["k"], [AggExpr("s", "any", "svc")],
+        map_exprs=[("k", Call("modulo", (Column("id"), lit(5)))),
+                   ("svc", Column("svc"))],
+    )
+    got2 = execute_plan(p2, ts)["out"].to_pandas().sort_values("k").reset_index(drop=True)
+    assert len(got2) == 5
+    assert set(got2["s"]) <= set(svc)
+
+
+def test_any_over_string_nulls_decode_to_none():
+    """Groups whose picker input is all-null yield null, not dictionary[0]."""
+    from pixie_tpu.plan import JoinOp
+
+    ts = TableStore()
+    rel_l = Relation.of(("k", DT.INT64), ("v", DT.FLOAT64))
+    rel_r = Relation.of(("k", DT.INT64), ("name", DT.STRING))
+    ts.create("left", rel_l, batch_rows=1024).write(
+        {"k": np.array([1, 1, 2, 3]), "v": np.ones(4)})
+    ts.create("right", rel_r, batch_rows=1024).write(
+        {"k": np.array([1]), "name": np.array(["one"])})
+    p = Plan()
+    l = p.add(MemorySourceOp(table="left"))
+    r = p.add(MemorySourceOp(table="right"))
+    j = p.add(JoinOp(how="left", left_on=["k"], right_on=["k"],
+                     output=[("left", "k", "k"), ("left", "v", "v"),
+                             ("right", "name", "name")]), parents=[l, r])
+    agg = p.add(AggOp(groups=["k"], values=[AggExpr("nm", "any", "name")]),
+                parents=[j])
+    p.add(MemorySinkOp(name="out"), parents=[agg])
+    res = execute_plan(p, ts)["out"]
+    by_k = {rec["k"]: rec["nm"] for rec in res.to_records()}
+    assert by_k[1] == "one"
+    assert by_k[2] is None and by_k[3] is None  # unmatched → null, not 'one'
+
+
+def test_distributed_any_string_ships_rows():
+    """The planner must NOT cut dict-valued any() as partial agg state."""
+    from pixie_tpu.parallel.cluster import LocalCluster
+    from pixie_tpu.parallel.distributed import DistributedPlanner
+    from pixie_tpu.plan import MapOp as _MapOp  # noqa: F401
+
+    rng = np.random.default_rng(22)
+    stores = {}
+    for a in range(2):
+        n = 3000
+        ids = rng.integers(0, 20, n)
+        svc = np.array([f"svc-{i % 4}-{a}" for i in ids])  # per-agent values!
+        stores[f"pem{a}"] = _mkstore(
+            n, ids, rng.exponential(1.0, n), extra=[("svc", DT.STRING, svc)])
+    cluster = LocalCluster(stores)
+    # planner check: the agg cut must be a rows channel
+    from pixie_tpu.compiler import compile_pxl
+
+    script = """
+df = px.DataFrame(table='events')
+df = df.groupby('id').agg(s=('svc', px.any), cnt=('v', px.count))
+px.display(df, 'out')
+"""
+    q = compile_pxl(script, cluster.schemas())
+    dp = cluster.planner.plan(q.plan)
+    assert all(ch.kind == "rows" for ch in dp.channels.values())
+    res = cluster.query(script)["out"]
+    df = res.to_pandas()
+    assert len(df) == 20
+    assert df["s"].notna().all()
+
+
 def test_string_key_beyond_max_groups_card_bound():
     """Two dict keys whose cardinality product exceeds MAX_GROUPS trigger the
     fallback (not an error) and produce exact results."""
