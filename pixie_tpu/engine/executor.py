@@ -1026,7 +1026,9 @@ class PlanExecutor:
         per-group reduction goes back to the device as chunked masked segment
         reductions over the exact group ids.
 
-        Returns (group_cols, dtypes, dicts, udas, in_types, state_np, G).
+        Returns (group_cols, dtypes, dicts, udas, in_types, state_np, G,
+        val_dicts) — val_dicts maps dict-valued picker outputs to the
+        dictionary their code-state decodes through.
         """
         self.stats["sorted_agg_fallbacks"] = self.stats.get("sorted_agg_fallbacks", 0) + 1
         parent = self.plan.parents(op)[0]

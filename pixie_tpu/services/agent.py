@@ -162,7 +162,8 @@ def main(argv=None):
     ap.add_argument("--name", required=True)
     ap.add_argument("--broker", required=True, help="host:port")
     ap.add_argument("--connector", action="append", default=[],
-                    help="seq_gen | proc_stats (repeatable)")
+                    help="seq_gen | proc_stats | perf_profiler | "
+                         "access_log:/path/to/log (repeatable)")
     ap.add_argument("--heartbeat-s", type=float, default=DEFAULT_HEARTBEAT_S)
     args = ap.parse_args(argv)
     host, port = args.broker.rsplit(":", 1)
@@ -179,6 +180,14 @@ def main(argv=None):
             from pixie_tpu.collect.proc_stats import ProcStatsConnector
 
             collector.register(ProcStatsConnector())
+        elif cname == "perf_profiler":
+            from pixie_tpu.collect.perf_profiler import PerfProfilerConnector
+
+            collector.register(PerfProfilerConnector())
+        elif cname.startswith("access_log:"):
+            from pixie_tpu.collect.access_log import AccessLogConnector
+
+            collector.register(AccessLogConnector(cname.split(":", 1)[1]))
         else:
             raise SystemExit(f"unknown connector {cname!r}")
     agent = Agent(args.name, host, int(port), collector=collector,

@@ -1,0 +1,95 @@
+"""Access-log → http_events connector (the userland socket-tracer analog)."""
+import numpy as np
+
+from pixie_tpu.collect.access_log import AccessLogConnector, parse_line
+from pixie_tpu.collect.core import Collector
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+
+LINES = [
+    '10.0.0.1 - - [30/Jul/2026:10:00:00 +0000] "GET /api/v1/items HTTP/1.1" 200 512 "-" "curl/8" 0.012',
+    '10.0.0.2 - - [30/Jul/2026:10:00:01 +0000] "POST /api/v1/cart HTTP/1.1" 500 99 "-" "Mozilla" 0.250',
+    '10.0.0.1 - - [30/Jul/2026:10:00:02 +0000] "GET /healthz HTTP/2.0" 200 -',
+    "garbage line that does not parse",
+]
+
+
+def test_parse_line_fields():
+    r = parse_line(LINES[0])
+    assert r["remote_addr"] == "10.0.0.1"
+    assert r["req_method"] == "GET" and r["req_path"] == "/api/v1/items"
+    assert r["resp_status"] == 200 and r["resp_body_size"] == 512
+    assert r["latency"] == 12_000_000
+    assert r["major_version"] == 1
+    r2 = parse_line(LINES[2])
+    assert r2["resp_body_size"] == 0 and r2["major_version"] == 2
+    assert parse_line(LINES[3]) is None
+
+
+def test_rotation_truncation_and_missing_path(tmp_path):
+    log = tmp_path / "rot.log"
+    log.write_text(LINES[0] + "\n")
+    conn = AccessLogConnector(str(log), follow=True)
+    out = conn.transfer_data()
+    assert len(out["http_events"]["time_"]) == 1
+    # in-place truncation to shorter content
+    log.write_text('1.2.3.4 - - [30/Jul/2026:10:00:03 +0000] "GET /x HTTP/1.1" 500 7\n')
+    out = conn.transfer_data()
+    assert len(out["http_events"]["time_"]) == 1
+    assert out["http_events"]["resp_status"][0] == 500
+    # logrotate-style rotation: old file renamed away (keeps its inode
+    # alive), a fresh file appears under the tailed path
+    log.rename(tmp_path / "rot.log.1")
+    log.write_text(LINES[0] + "\n" + LINES[1] + "\n")
+    out = conn.transfer_data()
+    assert len(out["http_events"]["time_"]) == 2
+    # missing path: tail keeps waiting (counted); one-shot exhausts
+    conn2 = AccessLogConnector(str(tmp_path / "nope.log"), follow=True)
+    assert conn2.transfer_data() == {}
+    assert conn2.read_errors == 1 and not conn2.exhausted
+    conn3 = AccessLogConnector(str(tmp_path / "nope2.log"), follow=False)
+    assert conn3.transfer_data() == {}
+    assert conn3.exhausted
+
+
+def test_two_logs_register_under_unique_names(tmp_path):
+    a, b = tmp_path / "a.log", tmp_path / "b.log"
+    a.write_text(LINES[0] + "\n")
+    b.write_text(LINES[1] + "\n")
+    c = Collector()
+    c.register(AccessLogConnector(str(a)))
+    c.register(AccessLogConnector(str(b)))
+    c.transfer_once()
+    assert c.store.table("http_events").stats()["rows_written"] == 2
+    c.stop()
+
+
+def test_tail_parse_query(tmp_path):
+    log = tmp_path / "access.log"
+    log.write_text("\n".join(LINES[:2]) + "\n")
+    collector = Collector()
+    conn = AccessLogConnector(str(log), sample_period_s=0.05)
+    collector.register(conn)
+    collector.transfer_once()
+    assert conn.lines_parsed == 2
+    # append more lines (incl. a partial one that completes later)
+    with log.open("a") as f:
+        f.write(LINES[2] + "\n" + LINES[3] + "\n10.0.0.9 - - [30/Jul/2026")
+    collector.transfer_once()
+    assert conn.lines_parsed == 3 and conn.lines_dropped == 1
+    with log.open("a") as f:
+        f.write(':10:00:05 +0000] "GET /late HTTP/1.1" 200 1\n')
+    collector.transfer_once()
+    assert conn.lines_parsed == 4
+    collector.stop()
+
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df.groupby('resp_status').agg(cnt=('latency', px.count))\n"
+        "px.display(df, 'o')\n",
+        collector.store.schemas(),
+    )
+    res = execute_plan(q.plan, collector.store)["o"]
+    by_status = {r["resp_status"]: r["cnt"] for r in res.to_records()}
+    assert by_status == {200: 3, 500: 1}

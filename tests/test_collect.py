@@ -121,8 +121,11 @@ px.display(df)
 """
     schemas = c.store.schemas()
     q = compile_pxl(src, schemas, now=1)
-    # Warm the XLA kernel on the empty table BEFORE ingest starts, so query
-    # iterations below genuinely overlap the poll thread.
+    # Warm the XLA kernel with the dictionary ALREADY populated (one synchronous
+    # transfer first): the kernel-cache signature includes dictionary size, so
+    # warming on the empty table would leave the first in-loop query re-jitting
+    # — by which time the (native-encode-fast) ingest could already be done.
+    c.transfer_once()
     execute_plan(q.plan, c.store)
     c.start()
     last_total = 0
