@@ -109,26 +109,31 @@ FEED_ROWS = _flags.define_int(
 # origins) is covered by including the table's rows_written in agg signatures.
 import collections as _collections
 import json as _json
+import threading as _threading
 
 _KERNEL_CACHE: "_collections.OrderedDict[str, tuple]" = _collections.OrderedDict()
 _KERNEL_CACHE_MAX = 128
+#: concurrent agent executors (cluster thread pool) share these caches
+_CACHE_LOCK = _threading.Lock()
 
 
 def _cache_get(sig):
     if sig is None:
         return None
-    got = _KERNEL_CACHE.get(sig)
-    if got is not None:
-        _KERNEL_CACHE.move_to_end(sig)
-    return got
+    with _CACHE_LOCK:
+        got = _KERNEL_CACHE.get(sig)
+        if got is not None:
+            _KERNEL_CACHE.move_to_end(sig)
+        return got
 
 
 def _cache_put(sig, value):
     if sig is None:
         return
-    _KERNEL_CACHE[sig] = value
-    while len(_KERNEL_CACHE) > _KERNEL_CACHE_MAX:
-        _KERNEL_CACHE.popitem(last=False)
+    with _CACHE_LOCK:
+        _KERNEL_CACHE[sig] = value
+        while len(_KERNEL_CACHE) > _KERNEL_CACHE_MAX:
+            _KERNEL_CACHE.popitem(last=False)
 
 
 def _op_sig(op) -> dict:
@@ -176,10 +181,11 @@ _DEVICE_CACHE_MAX = _flags.define_int(
 
 
 def _device_cache_get(key):
-    got = _DEVICE_CACHE.get(key)
-    if got is not None:
-        _DEVICE_CACHE.move_to_end(key)
-    return got
+    with _CACHE_LOCK:
+        got = _DEVICE_CACHE.get(key)
+        if got is not None:
+            _DEVICE_CACHE.move_to_end(key)
+        return got
 
 
 def _device_cache_put(key, cols: dict):
@@ -187,17 +193,19 @@ def _device_cache_put(key, cols: dict):
     nbytes = sum(v.nbytes for v in cols.values())
     if nbytes > _DEVICE_CACHE_MAX:
         return
-    _DEVICE_CACHE[key] = cols
-    _DEVICE_CACHE_BYTES += nbytes
-    while _DEVICE_CACHE_BYTES > _DEVICE_CACHE_MAX and _DEVICE_CACHE:
-        _k, v = _DEVICE_CACHE.popitem(last=False)
-        _DEVICE_CACHE_BYTES -= sum(x.nbytes for x in v.values())
+    with _CACHE_LOCK:
+        _DEVICE_CACHE[key] = cols
+        _DEVICE_CACHE_BYTES += nbytes
+        while _DEVICE_CACHE_BYTES > _DEVICE_CACHE_MAX and _DEVICE_CACHE:
+            _k, v = _DEVICE_CACHE.popitem(last=False)
+            _DEVICE_CACHE_BYTES -= sum(x.nbytes for x in v.values())
 
 
 def clear_device_cache():
     global _DEVICE_CACHE_BYTES
-    _DEVICE_CACHE.clear()
-    _DEVICE_CACHE_BYTES = 0
+    with _CACHE_LOCK:
+        _DEVICE_CACHE.clear()
+        _DEVICE_CACHE_BYTES = 0
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -1244,7 +1252,8 @@ class PlanExecutor:
                 break
             # A cached kernel's window-bin bucket is too small for this run's
             # time span: drop it and rebuild with the larger card.
-            _KERNEL_CACHE.pop(sig, None)
+            with _CACHE_LOCK:
+                _KERNEL_CACHE.pop(sig, None)
         else:
             # Both attempts failed: concurrent ingest grew the time span
             # between the rebuild's range read and the refresh.  Running with

@@ -266,9 +266,7 @@ class StreamQuery:
         # agg pipeline: run the partial fragment over the delta, merge into acc
         from pixie_tpu.parallel.partial import combine_partials, slice_partial
 
-        ex = PlanExecutor(pl.fragment, self.store, self.registry)
-        pb = ex.run_agent()[self.CHANNEL]
-        pl.token = hi
+        pb = self._poll_delta(pl)
         parts = [p for p in (pl.acc, pb) if p is not None]
         pl.acc = combine_partials(pl.agg, parts, self.registry)
 
@@ -282,22 +280,42 @@ class StreamQuery:
         if pl.watermark_bin is None or new_max > pl.watermark_bin:
             pl.watermark_bin = new_max
         # close every window strictly older than (newest bin - lateness)
-        close_below = pl.watermark_bin - self.lateness_ns
-        closing = wvals < close_below
-        if pl.emitted_below is not None:
-            # drop late rows for windows already emitted (exactly-once)
-            stale = wvals < pl.emitted_below
-            if stale.any():
-                pl.acc = slice_partial(pl.acc, np.nonzero(~stale)[0])
-                wvals = wvals[~stale]
-                closing = wvals < close_below
-        if not closing.any():
+        emit, pl.acc, pl.emitted_below = split_closing_windows(
+            pl.acc, pl.window_key, pl.watermark_bin - self.lateness_ns,
+            pl.emitted_below,
+        )
+        if emit is None:
             return None
-        emit = slice_partial(pl.acc, np.nonzero(closing)[0])
-        pl.acc = slice_partial(pl.acc, np.nonzero(~closing)[0])
-        pl.emitted_below = close_below
         hb = self._finalize(pl, emit)
         return self._run_post(pl, hb)
+
+    def _poll_delta(self, pl: _Pipeline):
+        """Run the partial agg fragment over this poll's row-id delta.
+        Caller must have set pl.source.since/stop_row_id; advances the token
+        on success.  Returns the delta PartialAggBatch."""
+        ex = PlanExecutor(pl.fragment, self.store, self.registry)
+        pb = ex.run_agent()[self.CHANNEL]
+        pl.token = pl.source.stop_row_id
+        return pb
+
+    def poll_partials(self) -> dict[str, object]:
+        """Distributed streaming hook: {sink_name: PartialAggBatch delta} for
+        each agg pipeline with new rows this poll.  The caller (cluster
+        stream) owns accumulation, watermarking, and emission — this side
+        ships deltas only, exactly like a distributed agent's partial channel.
+        """
+        out = {}
+        for pl in self.pipelines:
+            if pl.agg is None:
+                continue  # chain pipelines stream rows via poll()
+            table = self.store.table(pl.source.table)
+            hi = table.last_row_id()
+            if hi <= pl.token:
+                continue
+            pl.source.since_row_id = pl.token
+            pl.source.stop_row_id = hi
+            out[pl.sink_name] = self._poll_delta(pl)
+        return out
 
     def _finalize(self, pl: _Pipeline, pb) -> HostBatch:
         from pixie_tpu.parallel.partial import finalize_partial
@@ -310,6 +328,29 @@ class StreamQuery:
         )
         res = ex.run()[pl.sink_name]
         return res if res.num_rows else None
+
+
+def split_closing_windows(acc, window_key: str, close_below: int,
+                          emitted_below: Optional[int]):
+    """Exactly-once window-close step shared by single-store and cluster
+    streaming: drop groups for already-emitted windows (late data), split off
+    groups whose window start < close_below.
+
+    Returns (emit_pb | None, new_acc, new_emitted_below)."""
+    from pixie_tpu.parallel.partial import slice_partial
+
+    wvals = np.asarray(acc.key_cols[window_key], dtype=np.int64)
+    if emitted_below is not None:
+        stale = wvals < emitted_below
+        if stale.any():
+            acc = slice_partial(acc, np.nonzero(~stale)[0])
+            wvals = wvals[~stale]
+    closing = wvals < close_below
+    if not closing.any():
+        return None, acc, emitted_below
+    emit = slice_partial(acc, np.nonzero(closing)[0])
+    acc = slice_partial(acc, np.nonzero(~closing)[0])
+    return emit, acc, close_below
 
 
 def stream_pxl(

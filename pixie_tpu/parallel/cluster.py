@@ -58,6 +58,9 @@ class LocalCluster:
         self.merger_store = merger_store or TableStore()
         self.registry = registry
         self._meshes: dict = {}
+        import threading
+
+        self._mesh_lock = threading.Lock()
         agents = [
             AgentInfo(
                 name=name,
@@ -101,11 +104,12 @@ class LocalCluster:
         n = 1 << (n.bit_length() - 1)
         if n <= 1:
             return None
-        if n not in self._meshes:
-            from pixie_tpu.parallel.spmd import make_mesh
+        with self._mesh_lock:  # agent executors run concurrently
+            if n not in self._meshes:
+                from pixie_tpu.parallel.spmd import make_mesh
 
-            self._meshes[n] = make_mesh(n)
-        return self._meshes[n]
+                self._meshes[n] = make_mesh(n)
+            return self._meshes[n]
 
     def query(self, pxl_source: str, func: Optional[str] = None,
               func_args: Optional[dict] = None, now: Optional[int] = None,
@@ -141,17 +145,32 @@ class LocalCluster:
 
         # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
         #    each SPMD over the agent's device mesh (AgentInfo.n_devices).
+        #    Agents run CONCURRENTLY (they are separate processes in the
+        #    networked deployment); host-side work (feed assembly, dictionary
+        #    prescans, readbacks) overlaps even when they share one device.
         payloads: dict[str, list] = {cid: [] for cid in dp.channels}
         agent_stats: dict[str, dict] = {}
-        for agent_name, plan in dp.agent_plans.items():
+
+        def run_one(agent_name, plan):
             ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
                               mesh=self._agent_mesh(agent_name), analyze=analyze)
-            for cid, payload in ex.run_agent().items():
+            return agent_name, ex.run_agent(), dict(ex.stats)
+
+        items = list(dp.agent_plans.items())
+        if len(items) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=min(len(items), 16)) as pool:
+                outs = list(pool.map(lambda kv: run_one(*kv), items))
+        else:
+            outs = [run_one(*kv) for kv in items]
+        for agent_name, out, stats in outs:
+            for cid, payload in out.items():
                 if isinstance(payload, PartialAggBatch):
                     # round-trip the wire format on every query
                     payload = PartialAggBatch.from_bytes(payload.to_bytes())
                 payloads[cid].append(payload)
-            agent_stats[agent_name] = dict(ex.stats)
+            agent_stats[agent_name] = stats
 
         # 2. merge channel payloads (reference: Kelvin finalize / row merge).
         inputs: dict[str, HostBatch] = {}

@@ -38,24 +38,29 @@ def make_mesh(n_devices: int | None = None, axis: str = AGENT_AXIS) -> Mesh:
 
 _DEFAULT_MESH: Mesh | None = None
 _DEFAULT_MESH_READY = False
+_DEFAULT_MESH_LOCK = __import__("threading").Lock()
 
 
 def default_mesh() -> Mesh | None:
     """Process-wide mesh over ALL local devices, or None when single-device /
     disabled via PIXIE_TPU_SPMD=0.  This is what the engine's real query path
-    shards over (the reference's per-PEM fan-out becomes mesh axes)."""
+    shards over (the reference's per-PEM fan-out becomes mesh axes).
+    Thread-safe: concurrent agent executors race this on first use."""
     global _DEFAULT_MESH, _DEFAULT_MESH_READY
     if not _DEFAULT_MESH_READY:
         import os
 
-        _DEFAULT_MESH_READY = True
-        n = len(jax.devices())
-        # Clamp to a power of two: feed buckets are pow2-sized, so a 6-device
-        # mesh would fail every `bucket % n_dev == 0` gate and silently
-        # disable SPMD; a 4-device mesh actually runs.
-        n = 1 << (n.bit_length() - 1)
-        if os.environ.get("PIXIE_TPU_SPMD", "auto") != "0" and n > 1:
-            _DEFAULT_MESH = make_mesh(n)
+        with _DEFAULT_MESH_LOCK:
+            if not _DEFAULT_MESH_READY:
+                n = len(jax.devices())
+                # Clamp to a power of two: feed buckets are pow2-sized, so a
+                # 6-device mesh would fail every `bucket % n_dev == 0` gate
+                # and silently disable SPMD; a 4-device mesh actually runs.
+                n = 1 << (n.bit_length() - 1)
+                if os.environ.get("PIXIE_TPU_SPMD", "auto") != "0" and n > 1:
+                    _DEFAULT_MESH = make_mesh(n)
+                # publish the mesh BEFORE the ready flag (lock-free readers)
+                _DEFAULT_MESH_READY = True
     return _DEFAULT_MESH
 
 

@@ -1,0 +1,165 @@
+"""Distributed streaming: per-agent deltas, min-watermark window close."""
+import numpy as np
+
+from pixie_tpu.parallel.cluster import LocalCluster
+from pixie_tpu.parallel.streaming import ClusterStreamQuery
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+SEC = 1_000_000_000
+
+SCRIPT = """
+df = px.DataFrame(table='http_events').stream()
+df = df.rolling('1s').agg(cnt=('latency', px.count), s=('latency', px.sum))
+px.display(df, 'win')
+"""
+
+
+def _mkstore():
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING), ("latency", DT.FLOAT64)
+    )
+    ts.create("http_events", rel, batch_rows=1024)
+    return ts
+
+
+def _write(ts, times, lat=1.0):
+    t = ts.table("http_events")
+    t.write({
+        "time_": np.asarray(times, dtype=np.int64),
+        "service": ["a"] * len(times),
+        "latency": np.full(len(times), lat),
+    })
+
+
+def test_min_watermark_holds_window_for_lagging_agent():
+    stores = {"pem0": _mkstore(), "pem1": _mkstore()}
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(cluster, SCRIPT)
+    assert cs.poll() == {}
+    # pem0 races ahead into window [1s,2s); pem1 still in window [0,1s)
+    _write(stores["pem0"], [10, 20, 1 * SEC + 5])
+    _write(stores["pem1"], [30])
+    got = cs.poll()
+    assert got == {}, "window closed before the lagging agent's watermark"
+    # pem1 catches up past window 0 → it closes with BOTH agents' rows
+    _write(stores["pem1"], [1 * SEC + 50])
+    got = cs.poll()["win"].to_pandas()
+    assert list(got["time_"]) == [0]
+    assert list(got["cnt"]) == [3]  # 2 from pem0 + 1 from pem1
+    # eos flushes the open [1s,2s) window from both agents
+    fin = cs.close()["win"].to_pandas()
+    assert list(fin["time_"]) == [1 * SEC]
+    assert list(fin["cnt"]) == [2]
+
+
+def test_cluster_stream_totals_match_batch():
+    """Per-window streamed emissions must equal the batch oracle exactly."""
+    import pandas as pd
+
+    rng = np.random.default_rng(9)
+    stores = {f"pem{i}": _mkstore() for i in range(3)}
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(cluster, SCRIPT)
+    emitted = []
+    for step in range(4):
+        for name, ts in stores.items():
+            n = int(rng.integers(50, 150))
+            base = step * SEC
+            _write(ts, base + np.sort(rng.integers(0, SEC, n)), lat=2.0)
+        got = cs.poll()
+        if "win" in got:
+            emitted.append(got["win"].to_pandas())
+    fin = cs.close()
+    if "win" in fin:
+        emitted.append(fin["win"].to_pandas())
+    streamed = (
+        pd.concat(emitted).groupby("time_").agg(cnt=("cnt", "sum"), s=("s", "sum"))
+        .reset_index().sort_values("time_").reset_index(drop=True)
+    )
+    batch = cluster.query(
+        "df = px.DataFrame(table='http_events')\n"
+        "df = df.rolling('1s').agg(cnt=('latency', px.count), s=('latency', px.sum))\n"
+        "px.display(df, 'win')\n"
+    )["win"].to_pandas().sort_values("time_").reset_index(drop=True)
+    assert list(streamed["time_"]) == list(batch["time_"])
+    assert list(streamed["cnt"]) == list(batch["cnt"])
+    np.testing.assert_allclose(streamed["s"], batch["s"])
+    # exactly-once: each window emitted exactly once across the stream
+    all_windows = pd.concat(emitted)["time_"]
+    assert all_windows.is_unique
+
+
+def test_cluster_stream_collects_all_rows_exactly_once():
+    stores = {"pem0": _mkstore(), "pem1": _mkstore()}
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(cluster, SCRIPT)
+    seen = 0
+    rng = np.random.default_rng(3)
+    total = 0
+    for step in range(5):
+        for ts in stores.values():
+            n = int(rng.integers(20, 80))
+            _write(ts, step * SEC + np.sort(rng.integers(0, SEC, n)))
+            total += n
+        got = cs.poll()
+        if "win" in got:
+            seen += int(got["win"].to_pandas()["cnt"].sum())
+    fin = cs.close()
+    if "win" in fin:
+        seen += int(fin["win"].to_pandas()["cnt"].sum())
+    assert seen == total
+
+
+def test_silent_agent_holds_watermark_no_data_loss():
+    """An agent that hasn't produced yet gates window close; its late first
+    rows are NOT dropped (min-watermark over ALL participants)."""
+    stores = {"pem0": _mkstore(), "pem1": _mkstore()}
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(cluster, SCRIPT)
+    _write(stores["pem0"], [10, 1 * SEC + 5, 2 * SEC + 5])
+    assert cs.poll() == {}  # pem1 silent → nothing closes
+    _write(stores["pem1"], [20, 30])  # late first rows for window 0
+    got = cs.poll()
+    if "win" in got:
+        df = got["win"].to_pandas()
+        assert 0 not in list(df["time_"]) or df[df.time_ == 0]["cnt"].iloc[0] == 3
+    fin = cs.close()
+    import pandas as pd
+
+    parts = [got["win"].to_pandas()] if "win" in got else []
+    if "win" in fin:
+        parts.append(fin["win"].to_pandas())
+    allw = pd.concat(parts).groupby("time_")["cnt"].sum()
+    assert int(allw.sum()) == 5  # every row exactly once
+    assert int(allw.loc[0]) == 3  # pem1's late rows made it into window 0
+
+
+def test_heterogeneous_cluster_participation():
+    """Agents without the streamed table simply don't participate."""
+    stores = {"pem0": _mkstore(), "other": TableStore()}
+    stores["other"].create("unrelated", Relation.of(("x", DT.INT64)))
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(cluster, SCRIPT)
+    assert set(cs._agent_sqs) == {"pem0"}
+    _write(stores["pem0"], [1, 1 * SEC + 1])
+    got = cs.poll()["win"].to_pandas()
+    assert list(got["cnt"]) == [1]
+
+
+def test_cluster_stream_chain_unions_agents():
+    stores = {"pem0": _mkstore(), "pem1": _mkstore()}
+    cluster = LocalCluster(stores)
+    cs = ClusterStreamQuery(
+        cluster,
+        "df = px.DataFrame(table='http_events').stream()\n"
+        "df = df[df.latency > 0.5]\n"
+        "px.display(df, 'rows')\n",
+    )
+    _write(stores["pem0"], [1, 2], lat=1.0)
+    _write(stores["pem1"], [3], lat=0.1)  # filtered
+    got = cs.poll()["rows"]
+    assert got.num_rows == 2
+    _write(stores["pem1"], [4], lat=2.0)
+    assert cs.poll()["rows"].num_rows == 1

@@ -202,10 +202,12 @@ px.display(df, 'out')
     )
     stop = threading.Event()
     written = [0]
+    cap = 200_000  # bounded: an unthrottled writer would outrun the reader
+    # and the ring buffer would expire unseen rows (loss by design)
 
     def writer():
         t0 = 0
-        while not stop.is_set():
+        while not stop.is_set() and written[0] < cap:
             _write(ts, t0, 500)
             written[0] += 500
             t0 += 500
