@@ -91,10 +91,13 @@ _ALLOWED_PXL_NODES = frozenset(
 )
 
 
-#: underscore attributes that are real PxL API, not traversal (reference
-#: exec-context UDFs px._exec_hostname / px._exec_host_num_cpus used by the
-#: bundled node/perf_flamegraph scripts).  Exact names only — no dunders.
-_ALLOWED_UNDERSCORE_ATTRS = frozenset({"_exec_hostname", "_exec_host_num_cpus"})
+#: underscore attributes that are real PxL API, not traversal (the reference
+#: registers several underscore-prefixed UDFs scripts call as px._name).
+#: Exact single-underscore names only — never dunders or internal state.
+_ALLOWED_UNDERSCORE_ATTRS = frozenset({
+    "_exec_hostname", "_exec_host_num_cpus",
+    "_match_regex_rule", "_match_endpoint",
+})
 
 
 class _BoolOpRewrite(ast.NodeTransformer):

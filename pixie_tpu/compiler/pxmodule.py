@@ -27,6 +27,7 @@ _AGG_NAMES = (
     "stddev",
     "variance",
     "any",
+    "sample",
     "count_distinct",
 ) + tuple(f"p{q:02d}" for q in (1, 10, 25, 50, 75, 90, 95, 99))
 
@@ -236,6 +237,18 @@ class PxModule(types.ModuleType):
         import os
 
         return os.cpu_count() or 1
+
+    # Cluster identity (reference vizier_id/vizier_name UDFs backed by flags)
+    def vizier_id(self) -> str:
+        from pixie_tpu import flags
+
+        return flags.define_str("PX_VIZIER_ID", "00000000-0000-0000-0000-000000000000",
+                                "cluster id")
+
+    def vizier_name(self) -> str:
+        from pixie_tpu import flags
+
+        return flags.define_str("PX_VIZIER_NAME", "pixie-tpu-cluster", "cluster name")
 
     # ------------------------------------------------------ registry fallback
     def __getattr__(self, name: str):

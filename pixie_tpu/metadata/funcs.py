@@ -102,6 +102,41 @@ def register_metadata_funcs(r: Registry) -> None:
     r.register(_host("container_id_to_status", (_S,), _S,
                      lambda cid: _attr(mdstate.snapshot().containers_by_id.get(cid), "state")))
 
+    # ---- remaining reference lookup set (metadata_ops.h: start/stop times,
+    # qos/status, hostname, service ids/ips, container name index)
+    r.register(_host("upid_to_pod_status", (_U,), _S,
+                     lambda u: _attr(_pod(u), "phase")))
+    r.register(_host("upid_to_pod_qos", (_U,), _S,
+                     lambda u: _attr(_pod(u), "qos_class")))
+    r.register(_host("upid_to_hostname", (_U,), _S,
+                     lambda u: _attr(_pod(u), "node")))
+    r.register(_host("pod_id_to_start_time", (_S,), DT.TIME64NS,
+                     lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "create_time_ns", 0)))
+    r.register(_host("pod_id_to_stop_time", (_S,), DT.TIME64NS,
+                     lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "stop_time_ns", 0)))
+    r.register(_host("pod_name_to_stop_time", (_S,), DT.TIME64NS,
+                     lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)), "stop_time_ns", 0)))
+    r.register(_host("pod_id_to_service_id", (_S,), _S,
+                     lambda uid: _first_svc_uid(uid)))
+    r.register(_host("pod_name_to_service_id", (_S,), _S,
+                     lambda qn: _first_svc_uid(_pod_name_to_pod_id(qn))))
+    r.register(_host("service_id_to_cluster_ip", (_S,), _S,
+                     lambda uid: _attr(mdstate.snapshot().services_by_uid.get(uid), "cluster_ip")))
+    r.register(_host("service_id_to_external_ips", (_S,), _S,
+                     lambda uid: ",".join(_attr(mdstate.snapshot().services_by_uid.get(uid), "external_ips", ()))))
+    r.register(_host("service_name_to_namespace", (_S,), _S,
+                     lambda qn: qn.split("/", 1)[0] if "/" in qn else "",
+                     volatile=False))
+    r.register(_host("container_name_to_container_id", (_S,), _S, _cname_to_cid))
+    r.register(_host("container_id_to_start_time", (_S,), DT.TIME64NS,
+                     lambda cid: _attr(mdstate.snapshot().containers_by_id.get(cid), "start_time_ns", 0)))
+    r.register(_host("container_id_to_stop_time", (_S,), DT.TIME64NS,
+                     lambda cid: _attr(mdstate.snapshot().containers_by_id.get(cid), "stop_time_ns", 0)))
+    r.register(_host("container_name_to_start_time", (_S,), DT.TIME64NS,
+                     lambda n: _attr(mdstate.snapshot().containers_by_id.get(_cname_to_cid(n)), "start_time_ns", 0)))
+    r.register(_host("container_name_to_stop_time", (_S,), DT.TIME64NS,
+                     lambda n: _attr(mdstate.snapshot().containers_by_id.get(_cname_to_cid(n)), "stop_time_ns", 0)))
+
     # has_service_name/has_service_id: 1-arg form tests non-emptiness; the
     # 2-arg form used by drilldown scripts (px.has_service_name(col, 'ns/svc'))
     # tests membership, including the reference's grouped "svc1,svc2" encoding.
@@ -157,6 +192,16 @@ def _pod_id_to_service_name(uid: str) -> str:
         if svc:
             return svc.qualified_name
     return ""
+
+
+def _first_svc_uid(pod_uid: str) -> str:
+    for suid in mdstate.snapshot().pod_uid_to_service_uids.get(pod_uid, ()):
+        return suid
+    return ""
+
+
+def _cname_to_cid(name: str) -> str:
+    return mdstate.snapshot().container_name_to_cid.get(name, "")
 
 
 def _pod_name_to_pod_id(qualified: str) -> str:

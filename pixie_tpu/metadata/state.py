@@ -34,6 +34,7 @@ class PodInfo:
     create_time_ns: int = 0
     stop_time_ns: int = 0
     owner_deployment: str = ""
+    qos_class: str = ""  # Guaranteed | Burstable | BestEffort
 
     @property
     def qualified_name(self) -> str:
@@ -59,6 +60,8 @@ class ContainerInfo:
     name: str
     pod_uid: str
     state: str = "RUNNING"
+    start_time_ns: int = 0
+    stop_time_ns: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,6 +82,10 @@ class K8sSnapshot:
     #: UDF lookups are O(1) instead of scanning all pods per unique string.
     pod_name_to_uid: dict = dataclasses.field(default_factory=dict)
     service_name_to_uid: dict = dataclasses.field(default_factory=dict)
+    #: container name → cid.  Names duplicate across pods (sidecars); the
+    #: qualified "pod_uid/name" key disambiguates, bare name keeps
+    #: last-writer (documented ambiguity of the bare lookup).
+    container_name_to_cid: dict = dataclasses.field(default_factory=dict)
     dns: dict = dataclasses.field(default_factory=dict)  # ip -> hostname
     node_name: str = ""
 
@@ -147,6 +154,7 @@ class MetadataStateManager:
             pod_svc = dict(s.pod_uid_to_service_uids)
             pod_names = dict(s.pod_name_to_uid)
             svc_names = dict(s.service_name_to_uid)
+            ctr_names = dict(s.container_name_to_cid)
             dns = dict(s.dns)
             for u in updates:
                 kind = u["kind"]
@@ -169,6 +177,8 @@ class MetadataStateManager:
                 elif kind == "container":
                     c = ContainerInfo(**{k: v for k, v in u.items() if k != "kind"})
                     ctrs[c.cid] = c
+                    ctr_names[c.name] = c.cid
+                    ctr_names[f"{c.pod_uid}/{c.name}"] = c.cid
                 elif kind == "process":
                     upid = u["upid"]
                     if not isinstance(upid, UInt128):
@@ -188,6 +198,7 @@ class MetadataStateManager:
                 pods_by_uid=pods,
                 services_by_uid=svcs,
                 containers_by_id=ctrs,
+                container_name_to_cid=ctr_names,
                 upid_to_pod_uid=upid_pod,
                 upid_to_container_id=upid_ctr,
                 upid_to_cmdline=upid_cmd,

@@ -117,6 +117,15 @@ def register_all(r: Registry) -> None:
     # String concatenation (reference string_ops.cc StringConcat / '+'):
     # two dict columns evaluate over the observed pair cross-product LUT.
     r.register(_host("add", (_S, _S), _S, lambda a, b: (a or "") + (b or "")))
+    # URI ops (reference funcs/builtins/uri_ops.cc): parse → JSON struct,
+    # recompose from parts.
+    r.register(_host("uri_parse", (_S,), _S, _uri_parse))
+    r.register(_host("uri_recompose", (_S, _S, _I, _S), _S,
+                     lambda scheme, host, port, path:
+                     f"{scheme}://{host}" + (f":{port}" if port and port > 0 else "") + (path or "")))
+    # Rule matcher (reference _match_regex_rule): value × JSON {rule: regex}
+    # → first matching rule name, else "".
+    r.register(_host("_match_regex_rule", (_S, _S), _S, _match_regex_rule))
     r.register(_host("bytes_to_hex", (_S,), _S, lambda s: s.encode().hex()))
     r.register(_host("hex_to_ascii", (_S,), _S, _hex_to_ascii))
     # strip_prefix(prefix, s) — reference string_ops.cc argument order.
@@ -194,6 +203,9 @@ def register_all(r: Registry) -> None:
     r.register_uda("stddev", StddevUDA)
     r.register_uda("variance", VarianceUDA)
     r.register_uda("any", AnyUDA)
+    # reference 'sample' UDA: a representative group member.  Deterministic
+    # here (same picker as any) — order-independent across shards/batches.
+    r.register_uda("sample", AnyUDA)
     r.register_uda("quantiles", QuantilesUDA)
     for q in (0.01, 0.10, 0.25, 0.50, 0.75, 0.90, 0.95, 0.99):
         r.register_uda(f"p{int(round(q*100)):02d}", (lambda q=q: QuantileUDA(q)))
@@ -283,6 +295,41 @@ def _normalize_sql(q: str) -> str:
     q = _SQL_STRING_RE.sub("?", q)
     q = _SQL_NUMBER_RE.sub("?", q)
     return re.sub(r"\s+", " ", q).strip()
+
+
+def _uri_parse(uri: str) -> str:
+    import json as _json
+    from urllib.parse import parse_qsl, urlsplit
+
+    try:
+        u = urlsplit(uri or "")
+        # .port/.hostname parse lazily and can ALSO raise (bad port text)
+        out = {
+            "scheme": u.scheme, "host": u.hostname or "", "port": u.port or -1,
+            "path": u.path, "fragment": u.fragment,
+            "query": dict(parse_qsl(u.query)),
+        }
+    except ValueError:
+        return _json.dumps({"error": "unparseable uri"})
+    return _json.dumps(out)
+
+
+def _match_regex_rule(value: str, rules_json: str) -> str:
+    import json as _json
+
+    try:
+        rules = _json.loads(rules_json or "{}")
+    except ValueError:
+        return ""
+    if not isinstance(rules, dict):
+        return ""
+    for name, pattern in rules.items():
+        try:
+            if re.search(pattern, value or ""):
+                return name
+        except (re.error, TypeError):
+            continue
+    return ""
 
 
 def _normalize_struct(q: str) -> str:

@@ -131,3 +131,109 @@ px.display(out)
 def test_time_casts(store):
     out = run1(store, "df.t2 = px.int64_to_time(df.status)\ndf = df[['t2']]")
     assert out.t2[0] == 200
+
+
+# ---------------------------------------------------------------- round-2 adds
+
+
+def test_uri_and_rule_builtins():
+    import json as _json
+
+    from pixie_tpu.udf import registry
+    from pixie_tpu.types import DataType as DT
+
+    parse = registry.scalar("uri_parse", (DT.STRING,)).fn
+    d = _json.loads(parse("https://api.example.com:8443/v1/items?q=x&limit=5#frag"))
+    assert d["scheme"] == "https" and d["host"] == "api.example.com"
+    assert d["port"] == 8443 and d["path"] == "/v1/items"
+    assert d["query"] == {"q": "x", "limit": "5"}
+    rec = registry.scalar(
+        "uri_recompose", (DT.STRING, DT.STRING, DT.INT64, DT.STRING)).fn
+    assert rec("https", "h", 443, "/p") == "https://h:443/p"
+    assert rec("http", "h", -1, "/p") == "http://h/p"
+    match = registry.scalar("_match_regex_rule", (DT.STRING, DT.STRING)).fn
+    rules = _json.dumps({"api": "^/api/", "health": "healthz"})
+    assert match("/api/v1/x", rules) == "api"
+    assert match("/healthz", rules) == "health"
+    assert match("/other", rules) == ""
+
+
+def test_new_metadata_lookups():
+    from pixie_tpu.metadata.state import (
+        MetadataStateManager, global_manager, set_global_manager,
+    )
+    from pixie_tpu.types import DataType as DT, UInt128
+    from pixie_tpu.udf import registry
+
+    old = global_manager()
+    m = MetadataStateManager(asid=1, node_name="n1")
+    u = UInt128.make_upid(1, 42, 1000)
+    m.apply_updates([
+        {"kind": "pod", "uid": "p1", "name": "web-0", "namespace": "default",
+         "node": "n1", "ip": "10.0.0.1", "phase": "Running",
+         "create_time_ns": 5, "stop_time_ns": 9, "qos_class": "Burstable"},
+        {"kind": "container", "cid": "c1", "name": "web-ctr", "pod_uid": "p1",
+         "start_time_ns": 6, "stop_time_ns": 8},
+        {"kind": "service", "uid": "s1", "name": "web", "namespace": "default",
+         "cluster_ip": "10.96.0.1", "pod_uids": ["p1"]},
+        {"kind": "process", "upid": u, "pod_uid": "p1", "container_id": "c1"},
+    ])
+    set_global_manager(m)
+    try:
+        def call(name, *args, types=(DT.STRING,)):
+            return registry.scalar(name, types).fn(*args)
+
+        assert call("upid_to_pod_status", u, types=(DT.UINT128,)) == "Running"
+        assert call("upid_to_pod_qos", u, types=(DT.UINT128,)) == "Burstable"
+        assert call("upid_to_hostname", u, types=(DT.UINT128,)) == "n1"
+        assert call("pod_id_to_start_time", "p1") == 5
+        assert call("pod_id_to_stop_time", "p1") == 9
+        assert call("pod_name_to_stop_time", "default/web-0") == 9
+        assert call("pod_id_to_service_id", "p1") == "s1"
+        assert call("pod_name_to_service_id", "default/web-0") == "s1"
+        assert call("service_id_to_cluster_ip", "s1") == "10.96.0.1"
+        assert call("service_name_to_namespace", "default/web") == "default"
+        assert call("container_name_to_container_id", "web-ctr") == "c1"
+        assert call("container_id_to_start_time", "c1") == 6
+        assert call("container_name_to_stop_time", "web-ctr") == 8
+    finally:
+        set_global_manager(old)
+
+
+def test_sample_uda_in_pxl():
+    import numpy as np
+
+    from pixie_tpu.compiler import compile_pxl
+    from pixie_tpu.engine import execute_plan
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    ts = TableStore()
+    ts.create("t", Relation.of(("k", DT.STRING), ("v", DT.FLOAT64))).write(
+        {"k": ["a", "a", "b"], "v": [1.0, 2.0, 3.0]})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='t')\n"
+        "df = df.groupby('k').agg(rep=('v', px.sample))\n"
+        "px.display(df, 'o')\n",
+        ts.schemas(),
+    )
+    res = execute_plan(q.plan, ts)["o"].to_pandas().sort_values("k")
+    assert list(res["k"]) == ["a", "b"]
+    assert res["rep"].iloc[0] in (1.0, 2.0) and res["rep"].iloc[1] == 3.0
+
+
+def test_uri_and_rule_builtins_malformed_inputs():
+    import json as _json
+
+    from pixie_tpu.udf import registry
+    from pixie_tpu.types import DataType as DT
+
+    parse = registry.scalar("uri_parse", (DT.STRING,)).fn
+    assert _json.loads(parse("http://host:abc/x")).get("error")
+    assert _json.loads(parse("http://host:99999999/x")).get("error")
+    match = registry.scalar("_match_regex_rule", (DT.STRING, DT.STRING)).fn
+    assert match("/x", '["a"]') == ""          # non-dict JSON
+    assert match("/x", "null") == ""
+    assert match("/x", '{"r": 5}') == ""       # non-string pattern
+    assert match("/x", "not json") == ""
