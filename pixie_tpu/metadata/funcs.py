@@ -70,8 +70,7 @@ def register_metadata_funcs(r: Registry) -> None:
                      lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "node")))
     r.register(_host("pod_id_to_service_name", (_S,), _S, _pod_id_to_service_name))
     r.register(_host("pod_name_to_pod_id", (_S,), _S, _pod_name_to_pod_id))
-    r.register(_host("pod_name_to_namespace", (_S,), _S,
-                     lambda qn: qn.split("/", 1)[0] if "/" in qn else "",
+    r.register(_host("pod_name_to_namespace", (_S,), _S, _qn_namespace,
                      volatile=False))
     r.register(_host("pod_name_to_service_name", (_S,), _S,
                      lambda qn: _pod_id_to_service_name(_pod_name_to_pod_id(qn))))
@@ -116,16 +115,14 @@ def register_metadata_funcs(r: Registry) -> None:
                      lambda uid: _attr(mdstate.snapshot().pods_by_uid.get(uid), "stop_time_ns", 0)))
     r.register(_host("pod_name_to_stop_time", (_S,), DT.TIME64NS,
                      lambda qn: _attr(mdstate.snapshot().pods_by_uid.get(_pod_name_to_pod_id(qn)), "stop_time_ns", 0)))
-    r.register(_host("pod_id_to_service_id", (_S,), _S,
-                     lambda uid: _first_svc_uid(uid)))
+    r.register(_host("pod_id_to_service_id", (_S,), _S, _first_svc_uid))
     r.register(_host("pod_name_to_service_id", (_S,), _S,
                      lambda qn: _first_svc_uid(_pod_name_to_pod_id(qn))))
     r.register(_host("service_id_to_cluster_ip", (_S,), _S,
                      lambda uid: _attr(mdstate.snapshot().services_by_uid.get(uid), "cluster_ip")))
     r.register(_host("service_id_to_external_ips", (_S,), _S,
                      lambda uid: ",".join(_attr(mdstate.snapshot().services_by_uid.get(uid), "external_ips", ()))))
-    r.register(_host("service_name_to_namespace", (_S,), _S,
-                     lambda qn: qn.split("/", 1)[0] if "/" in qn else "",
+    r.register(_host("service_name_to_namespace", (_S,), _S, _qn_namespace,
                      volatile=False))
     r.register(_host("container_name_to_container_id", (_S,), _S, _cname_to_cid))
     r.register(_host("container_id_to_start_time", (_S,), DT.TIME64NS,
@@ -192,6 +189,11 @@ def _pod_id_to_service_name(uid: str) -> str:
         if svc:
             return svc.qualified_name
     return ""
+
+
+def _qn_namespace(qualified: str) -> str:
+    """'ns/name' → 'ns' (pod and service qualified names share the format)."""
+    return qualified.split("/", 1)[0] if "/" in qualified else ""
 
 
 def _first_svc_uid(pod_uid: str) -> str:

@@ -1,0 +1,126 @@
+"""Multi-host execution: jax.distributed + global meshes over ICI/DCN.
+
+Reference scale-out: one Carnot process per node, NATS control, gRPC data
+(SURVEY.md §2.5/§5).  The TPU-native equivalent splits by fabric:
+
+  * WITHIN a host/slice: the engine's SPMD path (parallel/spmd.py) over the
+    host's local devices — collectives ride ICI.
+  * ACROSS hosts: `init_multihost()` brings up the JAX distributed runtime
+    (coordinator + N processes); `global_mesh()` then spans EVERY device in
+    the job, and jitted collectives over it ride ICI within a slice and DCN
+    between slices — XLA inserts the transport, exactly the scaling-book
+    recipe (mesh → shardings → collectives).
+  * The framework's control plane (services.broker/agent over framed TCP)
+    is orthogonal: each host process remains an agent; a query's partial
+    aggregation can either merge host-side (value-keyed channels, default)
+    or in-program over the global mesh when all agents joined one jax
+    distributed job (`AgentInfo.n_devices` + this module).
+
+Single-process usage degenerates cleanly: init is a no-op and global_mesh()
+equals the local default mesh, so everything here is exercised by the normal
+test suite; real multi-host needs `JAX coordinator` networking that only
+exists on multi-host pods.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from pixie_tpu import flags
+from pixie_tpu.parallel.spmd import AGENT_AXIS
+
+_initialized = False
+
+COORD_FLAG = flags.define_str(
+    "PX_JAX_COORDINATOR", "", "host:port of the jax.distributed coordinator "
+    "(empty = single-process)")
+NPROC_FLAG = flags.define_int(
+    "PX_JAX_NUM_PROCESSES", 1, "process count in the jax distributed job")
+PROC_ID_FLAG = flags.define_int(
+    "PX_JAX_PROCESS_ID", 0, "this process's id in the jax distributed job")
+
+
+def init_multihost(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join (or skip) a multi-host JAX job.  Args default to the PX_JAX_*
+    flags; returns True when a distributed runtime was initialized.
+
+    Call BEFORE any other JAX use in the process (jax.distributed contract).
+    """
+    global _initialized
+    coordinator = coordinator or flags.get("PX_JAX_COORDINATOR")
+    if not coordinator:
+        return False
+    if _initialized:
+        return True
+    num_processes = num_processes or flags.get("PX_JAX_NUM_PROCESSES")
+    process_id = (
+        process_id if process_id is not None else flags.get("PX_JAX_PROCESS_ID")
+    )
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    _initialized = True
+    return True
+
+
+def global_mesh(axis: str = AGENT_AXIS):
+    """Mesh over the job's devices (all hosts).  In a single-process job this
+    equals the local default mesh; in a multi-host job jit'd psum/all_gather
+    over it spans DCN.
+
+    The pow2 clamp applies PER HOST, never to the global list — a global
+    clamp could drop entire hosts, leaving those processes with no
+    addressable mesh devices (which breaks device_put/collectives there).
+    Every process keeps the same number of its own devices; with a pow2
+    process count the total stays pow2 (the executor's feed-divisibility
+    gate), otherwise SPMD feeds degrade gracefully to single-device."""
+    devs = jax.devices()  # global across the distributed job
+    n_proc = max(jax.process_count(), 1)
+    per_host = len(devs) // n_proc
+    per_host = 1 << (max(per_host, 1).bit_length() - 1)  # pow2 clamp per host
+    if per_host * n_proc <= 1:
+        return None
+    by_proc: dict[int, list] = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, []).append(d)
+    chosen = []
+    for pid in sorted(by_proc):
+        chosen.extend(by_proc[pid][:per_host])
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(chosen), (axis,))
+
+
+def host_local_slice(mesh) -> tuple[int, int]:
+    """[start, stop) positions of THIS process's devices along the mesh axis —
+    the data-placement contract for multi-host feeds: each host feeds only its
+    addressable shard (jax.Array per-host data semantics)."""
+    if mesh is None:
+        return (0, 0)
+    me = jax.process_index()
+    flat = list(mesh.devices.flat)
+    idx = [i for i, d in enumerate(flat) if d.process_index == me]
+    if not idx:
+        return (0, 0)
+    return (min(idx), max(idx) + 1)
+
+
+def describe() -> dict:
+    """Topology snapshot for logs/metrics/UDTFs."""
+    return {
+        "initialized": _initialized,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+        "platform": jax.devices()[0].platform if jax.devices() else "none",
+    }

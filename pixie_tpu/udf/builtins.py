@@ -122,7 +122,7 @@ def register_all(r: Registry) -> None:
     r.register(_host("uri_parse", (_S,), _S, _uri_parse))
     r.register(_host("uri_recompose", (_S, _S, _I, _S), _S,
                      lambda scheme, host, port, path:
-                     f"{scheme}://{host}" + (f":{port}" if port and port > 0 else "") + (path or "")))
+                     f"{scheme}://{host}" + (f":{port}" if port >= 0 else "") + (path or "")))
     # Rule matcher (reference _match_regex_rule): value × JSON {rule: regex}
     # → first matching rule name, else "".
     r.register(_host("_match_regex_rule", (_S, _S), _S, _match_regex_rule))
@@ -305,7 +305,8 @@ def _uri_parse(uri: str) -> str:
         u = urlsplit(uri or "")
         # .port/.hostname parse lazily and can ALSO raise (bad port text)
         out = {
-            "scheme": u.scheme, "host": u.hostname or "", "port": u.port or -1,
+            "scheme": u.scheme, "host": u.hostname or "",
+            "port": -1 if u.port is None else u.port,  # 0 is a real port
             "path": u.path, "fragment": u.fragment,
             "query": dict(parse_qsl(u.query)),
         }
