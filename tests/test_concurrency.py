@@ -1,0 +1,168 @@
+"""Race hardening: concurrent queries × ingest × metadata churn × streaming.
+
+The reference leans on TSAN/ASAN configs (SURVEY §5); the Python build's
+equivalent is exercising every shared structure from many threads at once:
+the global kernel/device caches (lock-protected), dictionary append paths,
+copy-on-write metadata snapshots, and the collector's store.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.metadata.state import (
+    MetadataStateManager, global_manager, set_global_manager,
+)
+from pixie_tpu.parallel.cluster import LocalCluster
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation, UInt128
+
+N_THREADS = 4
+ROUNDS = 4
+
+
+@pytest.fixture
+def churn_metadata():
+    old = global_manager()
+    m = MetadataStateManager(asid=1, node_name="n1")
+    set_global_manager(m)
+    yield m
+    set_global_manager(old)
+
+
+def test_concurrent_queries_ingest_and_metadata(churn_metadata):
+    m = churn_metadata
+    rng = np.random.default_rng(0)
+    stores = {}
+    upids = [UInt128.make_upid(1, 100 + i, i) for i in range(8)]
+    for i, u in enumerate(upids):
+        m.apply_updates([
+            {"kind": "pod", "uid": f"p{i}", "name": f"pod-{i}",
+             "namespace": "default", "ip": f"10.0.0.{i+1}"},
+            {"kind": "process", "upid": u, "pod_uid": f"p{i}"},
+        ])
+    for a in range(2):
+        ts = TableStore()
+        rel = Relation.of(("time_", DT.TIME64NS), ("upid", DT.UINT128),
+                          ("svc", DT.STRING), ("v", DT.FLOAT64))
+        t = ts.create("events", rel, batch_rows=2048)
+        t.write({
+            "time_": np.arange(4096, dtype=np.int64),
+            "upid": [upids[i] for i in rng.integers(0, 8, 4096)],
+            "svc": np.array(["a", "b", "c"])[rng.integers(0, 3, 4096)],
+            "v": rng.exponential(1.0, 4096),
+        })
+        stores[f"pem{a}"] = ts
+    cluster = LocalCluster(stores)
+
+    script = """
+df = px.DataFrame(table='events')
+df.pod = df.ctx['pod']
+df = df.groupby(['svc', 'pod']).agg(cnt=('v', px.count), s=('v', px.sum))
+px.display(df, 'out')
+"""
+    errors = []
+    barrier = threading.Barrier(N_THREADS + 2)
+    stop = threading.Event()
+
+    def querier():
+        barrier.wait()
+        try:
+            for _ in range(ROUNDS):
+                res = cluster.query(script)["out"]
+                df = res.to_pandas()
+                # invariant: counts are positive, sums finite
+                assert (df["cnt"] > 0).all()
+                assert np.isfinite(df["s"]).all()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    def writer():
+        barrier.wait()
+        r = np.random.default_rng(99)
+        t0 = 10_000
+        iters = 0
+        while not stop.is_set() and iters < 500:  # bounded: no runaway growth
+            for ts in stores.values():
+                ts.table("events").write({
+                    "time_": np.arange(t0, t0 + 512, dtype=np.int64),
+                    "upid": [upids[i] for i in r.integers(0, 8, 512)],
+                    "svc": np.array(["a", "b", "c"])[r.integers(0, 3, 512)],
+                    "v": r.exponential(1.0, 512),
+                })
+            t0 += 512
+            iters += 1
+
+    def md_churner():
+        barrier.wait()
+        i = 0
+        while not stop.is_set():
+            m.apply_updates([{
+                "kind": "pod", "uid": f"p{i % 8}", "name": f"pod-{i % 8}",
+                "namespace": "default", "ip": f"10.0.0.{i % 8 + 1}",
+                "phase": ["Running", "Pending"][i % 2],
+            }])
+            i += 1
+
+    threads = [threading.Thread(target=querier) for _ in range(N_THREADS)]
+    threads += [threading.Thread(target=writer, daemon=True),
+                threading.Thread(target=md_churner, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads[:N_THREADS]:
+        t.join(timeout=120)
+    stop.set()
+    for t in threads[N_THREADS:]:
+        t.join(timeout=10)
+    # a timed-out join means a hang/deadlock — fail loudly, don't pass
+    stuck = [t.name for t in threads if t.is_alive()]
+    assert not stuck, f"threads did not finish (deadlock?): {stuck}"
+    assert not errors, errors
+
+
+def test_concurrent_single_store_queries_share_caches():
+    """Many threads running the same + different plans against one store:
+    the global kernel cache must stay consistent (no mis-keyed kernels)."""
+    rng = np.random.default_rng(1)
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("k", DT.STRING), ("v", DT.FLOAT64))
+    t = ts.create("t", rel, batch_rows=2048)
+    n = 16384
+    ks = np.array(["x", "y", "z"])[rng.integers(0, 3, n)]
+    vs = rng.exponential(1.0, n)
+    t.write({"time_": np.arange(n, dtype=np.int64), "k": ks, "v": vs})
+    import pandas as pd
+
+    want = pd.DataFrame({"k": ks, "v": vs}).groupby("k")["v"].sum()
+
+    scripts = [
+        "df = px.DataFrame(table='t')\n"
+        "df = df.groupby('k').agg(s=('v', px.sum))\npx.display(df, 'o')",
+        "df = px.DataFrame(table='t')\n"
+        "df = df[df.v > 0.5]\npx.display(df, 'o')",
+        "df = px.DataFrame(table='t')\n"
+        "df = df.groupby('k').agg(c=('v', px.count))\npx.display(df, 'o')",
+    ]
+    errors = []
+
+    def run(i):
+        try:
+            q = compile_pxl(scripts[i % len(scripts)], ts.schemas())
+            res = execute_plan(q.plan, ts)["o"]
+            if i % len(scripts) == 0:
+                got = res.to_pandas().set_index("k")["s"]
+                for k in ("x", "y", "z"):
+                    assert abs(got[k] - want[k]) < 1e-6 * max(1.0, abs(want[k]))
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    stuck = [t.name for t in threads if t.is_alive()]
+    assert not stuck, f"threads did not finish (deadlock?): {stuck}"
+    assert not errors, errors
