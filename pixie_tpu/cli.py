@@ -129,7 +129,7 @@ def cmd_run(args) -> int:
         from pixie_tpu.services.client import Client
 
         host, port = args.broker.rsplit(":", 1)
-        client = Client(host, int(port))
+        client = Client(host, int(port), auth_token=args.auth_token)
         execute = lambda fn, fargs: client.execute_script(  # noqa: E731
             source, func=fn, func_args=fargs, analyze=args.analyze
         )
@@ -202,7 +202,8 @@ def cmd_broker(args) -> int:
     from pixie_tpu.services.broker import Broker
 
     broker = Broker(host=args.host, port=args.port,
-                    datastore_path=args.datastore).start()
+                    datastore_path=args.datastore,
+                    auth_token=args.auth_token).start()
     print(f"broker listening on {args.host}:{broker.port} "
           f"(datastore={args.datastore})", flush=True)
     try:
@@ -217,6 +218,8 @@ def cmd_agent(args) -> int:
     from pixie_tpu.services.agent import main as agent_main
 
     argv = ["--name", args.name, "--broker", args.broker]
+    if args.auth_token:
+        argv += ["--auth-token", args.auth_token]
     for c in args.connector or []:
         argv += ["--connector", c]
     agent_main(argv)
@@ -230,6 +233,8 @@ def main(argv=None) -> int:
     run = sub.add_parser("run", help="run a PxL script and render results")
     run.add_argument("script", help=".pxl file or bundled-script directory")
     run.add_argument("--broker", help="host:port (default: in-process demo data)")
+    run.add_argument("--auth-token", default=None,
+                     help="shared secret when the broker enables auth")
     run.add_argument("--arg", action="append", help="vis variable override k=v")
     run.add_argument("--analyze", action="store_true")
     run.add_argument("--max-rows", type=int, default=40)
@@ -247,12 +252,15 @@ def main(argv=None) -> int:
     br.add_argument("--host", default="127.0.0.1")
     br.add_argument("--port", type=int, default=59300)
     br.add_argument("--datastore", default=":memory:")
+    br.add_argument("--auth-token", default=None,
+                    help="require this shared secret from every connection")
     br.set_defaults(fn=cmd_broker)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)
     ag.add_argument("--broker", required=True)
     ag.add_argument("--connector", action="append")
+    ag.add_argument("--auth-token", default=None)
     ag.set_defaults(fn=cmd_agent)
 
     args = ap.parse_args(argv)

@@ -808,7 +808,7 @@ class PlanExecutor:
             src_sig.pop("start_time", None)
             src_sig.pop("stop_time", None)
         key = {
-            "reg": id(self.registry),
+            "reg": self.registry.uid,
             "table": (head.table, table.uid),
             "src": src_sig,
             "chain": [_op_sig(op) for op in chain],
@@ -1118,7 +1118,7 @@ class PlanExecutor:
         # jax.jit then reuses traces across calls/polls instead of recompiling
         # the reduction every invocation.
         upd_key = (
-            "sorted_upd", id(self.registry),
+            "sorted_upd", self.registry.uid,
             tuple((ae.out_name, ae.fn, ae.arg) for ae in op.values), Gb,
         )
         cached_upd = _cache_get(_json.dumps(upd_key))

@@ -33,7 +33,9 @@ class Agent:
         registry=None,
         heartbeat_s: float = DEFAULT_HEARTBEAT_S,
         n_devices: Optional[int] = None,
+        auth_token: Optional[str] = None,
     ):
+        self.auth_token = auth_token
         self.name = name
         self.broker = (broker_host, broker_port)
         self.store = store or (collector.store if collector else TableStore())
@@ -57,6 +59,9 @@ class Agent:
         if self.collector is not None:
             self.collector.start()
         self.conn = dial(*self.broker, on_frame=self._on_frame)
+        if self.auth_token is not None:
+            self.conn.send(wire.encode_json(
+                {"msg": "auth", "token": self.auth_token}))
         self._register()
         if not self._registered.wait(timeout=timeout):
             raise TimeoutError(f"agent {self.name}: broker did not ack registration")
@@ -165,6 +170,8 @@ def main(argv=None):
                     help="seq_gen | proc_stats | perf_profiler | "
                          "access_log:/path/to/log (repeatable)")
     ap.add_argument("--heartbeat-s", type=float, default=DEFAULT_HEARTBEAT_S)
+    ap.add_argument("--auth-token", default=None,
+                    help="shared secret; required if the broker enables auth")
     args = ap.parse_args(argv)
     host, port = args.broker.rsplit(":", 1)
 
@@ -191,7 +198,7 @@ def main(argv=None):
         else:
             raise SystemExit(f"unknown connector {cname!r}")
     agent = Agent(args.name, host, int(port), collector=collector,
-                  heartbeat_s=args.heartbeat_s)
+                  heartbeat_s=args.heartbeat_s, auth_token=args.auth_token)
     agent.start()
     try:
         while True:

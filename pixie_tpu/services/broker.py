@@ -52,7 +52,13 @@ class Broker:
         hb_expiry_s: float = 15.0,
         registry=None,
         query_timeout_s: float = DEFAULT_QUERY_TIMEOUT_S,
+        auth_token: Optional[str] = None,
     ):
+        #: shared-secret auth (reference fronts this port with JWT,
+        #: src/shared/services/).  When set, every connection must present the
+        #: token in an `auth` frame before any other message is honored.  The
+        #: port must never be exposed beyond a trusted network regardless.
+        self.auth_token = auth_token
         self.kv = KVStore(datastore_path)
         self.registry = AgentRegistry(self.kv, expiry_s=hb_expiry_s)
         self.udf_registry = registry
@@ -126,9 +132,30 @@ class Broker:
     # ------------------------------------------------------------------ frames
     def _on_frame(self, conn: Connection, frame: bytes):
         kind, payload = wire.decode_frame(frame)
+        if self.auth_token is not None and not conn.state.get("authed"):
+            import hmac
+
+            # compare_digest over utf-8 bytes: str operands raise TypeError
+            # on non-ASCII, which would skip the reject-and-close path.
+            if (kind == "json" and payload.get("msg") == "auth"
+                    and hmac.compare_digest(
+                        str(payload.get("token", "")).encode(),
+                        self.auth_token.encode())):
+                conn.state["authed"] = True
+                conn.send(wire.encode_json({"msg": "auth_ok"}))
+            else:
+                rid = payload.get("req_id") if kind == "json" else None
+                conn.send(wire.encode_json(
+                    {"msg": "error", "req_id": rid,
+                     "error": "authentication required"}))
+                conn.close()
+            return
         if kind == "json":
             msg = payload.get("msg")
-            if msg == "register":
+            if msg == "auth":
+                conn.state["authed"] = True
+                conn.send(wire.encode_json({"msg": "auth_ok"}))
+            elif msg == "register":
                 self._handle_register(conn, payload)
             elif msg == "heartbeat":
                 if not self.registry.heartbeat(payload["agent"]):
@@ -308,16 +335,15 @@ class Broker:
         }
         if not specs or not targets:
             return
-        with self._qlock:
-            self._req_counter += 1
-            rid = f"tp{self._req_counter}"
-            ctx = _QueryCtx(set(targets), set())
-            # one ack per (agent, spec); track by counting agents per spec round
-            self._queries[rid] = ctx
-        try:
-            for spec in specs:
-                ctx.pending_agents = set(targets)
-                ctx.done.clear()
+        # A fresh req_id + ctx per spec round: a straggler ack from round N
+        # that lands after its timeout cannot corrupt round N+1's accounting.
+        for spec in specs:
+            with self._qlock:
+                self._req_counter += 1
+                rid = f"tp{self._req_counter}"
+                ctx = _QueryCtx(set(targets), set())
+                self._queries[rid] = ctx
+            try:
                 for conn in targets.values():
                     conn.send(wire.encode_json({
                         "msg": "deploy_tracepoint", "req_id": rid, "spec": spec,
@@ -328,9 +354,9 @@ class Broker:
                     )
                 if ctx.error:
                     raise Unavailable(ctx.error)
-        finally:
-            with self._qlock:
-                self._queries.pop(rid, None)
+            finally:
+                with self._qlock:
+                    self._queries.pop(rid, None)
 
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,

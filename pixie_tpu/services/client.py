@@ -32,13 +32,17 @@ class _Pending:
 class Client:
     """Blocking client (the pxapi Conn analog)."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 120.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 120.0,
+                 auth_token: Optional[str] = None):
         self.timeout_s = timeout_s
         self._pending: dict[str, _Pending] = {}
         self._lock = threading.Lock()
         self._req = 0
         self.conn: Connection = dial(host, port, on_frame=self._on_frame,
                                      on_close=self._on_close)
+        if auth_token is not None:
+            self.conn.send(wire.encode_json(
+                {"msg": "auth", "token": auth_token}))
 
     def close(self):
         self.conn.close()

@@ -64,6 +64,21 @@ class TracepointManager:
         rel = Relation.from_dict(spec["schema"])
         with self._lock:
             tp = self._tps.get(spec["name"])
+            # Ownership guard: a script-supplied table_name that already exists
+            # in the store must be owned by THIS tracepoint — never a core
+            # telemetry table (http_events, ...) and never another
+            # tracepoint's output table.  The reference confines dynamic
+            # trace output to its own new tables.
+            owner = next((t.name for t in self._tps.values()
+                          if t.table_name == spec["table_name"]), None)
+            if (self.store.has(spec["table_name"])
+                    and owner != spec["name"]):
+                from pixie_tpu.status import InvalidArgument
+                whose = (f"tracepoint {owner!r}" if owner is not None
+                         else "a non-tracepoint table")
+                raise InvalidArgument(
+                    f"tracepoint table {spec['table_name']!r} collides with "
+                    f"{whose}; choose a new table name")
             if tp is None:
                 tp = TracepointInfo(
                     name=spec["name"], table_name=spec["table_name"],

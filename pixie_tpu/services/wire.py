@@ -200,8 +200,12 @@ def decode_frame(data: bytes):
         if off + nb > len(data):
             raise InvalidArgument("wire: truncated buffer")
         arr = np.frombuffer(data[off : off + nb], dtype=np.dtype(s))
+        # Checked-Python-int product: np.prod would wrap in int64 on an
+        # adversarial shape like [2**40, 2**40] and falsely pass.
+        import math
+
         shape = tuple(int(x) for x in b["shape"])
-        if int(np.prod(shape)) * arr.itemsize != nb:
+        if any(d < 0 for d in shape) or math.prod(shape) * arr.itemsize != nb:
             raise InvalidArgument("wire: buffer shape/nbytes mismatch")
         bufs[b["name"]] = arr.reshape(shape).copy()  # writable, owned
         off += nb

@@ -17,6 +17,7 @@ re-design:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Callable, Sequence
 
 import jax
@@ -371,10 +372,16 @@ class QuantilesUDA(UDA):
 # -------------------------------------------------------------------- registry
 
 
+_registry_uid = itertools.count(1)
+
+
 class Registry:
     """Name → overloads (reference src/carnot/udf/registry.h:101)."""
 
     def __init__(self):
+        # Process-unique uid for kernel-cache keys: id() can be reused after
+        # GC, aliasing a stale cached kernel to a new registry.
+        self.uid = next(_registry_uid)
         self._scalar: dict[str, list[ScalarUDF]] = {}
         self._uda: dict[str, Callable[[], UDA]] = {}
         self._udtf: dict = {}

@@ -274,3 +274,91 @@ px.display(df, 'out')
         proc.terminate()
         proc.wait(timeout=10)
         broker.stop()
+
+
+# ------------------------------------------------------------------ auth + guards
+
+
+def test_broker_auth_rejects_and_accepts():
+    """With auth_token set, unauthenticated peers are refused; token holders
+    work end-to-end (ADVICE r2: broker port had no authentication)."""
+    broker = Broker(hb_expiry_s=2.0, query_timeout_s=30.0,
+                    auth_token="s3cret").start()
+    try:
+        # no token: execute_script gets an auth error and the conn closes
+        bad = Client("127.0.0.1", broker.port, timeout_s=5.0)
+        with pytest.raises((QueryError, Exception)) as ei:
+            bad.execute_script(SCRIPT)
+        assert "auth" in str(ei.value).lower() or "closed" in str(ei.value).lower() \
+            or "lost" in str(ei.value).lower()
+        bad.close()
+        # wrong token: also refused
+        bad2 = Client("127.0.0.1", broker.port, timeout_s=5.0,
+                      auth_token="wrong")
+        with pytest.raises(Exception):
+            bad2.schemas()
+        bad2.close()
+        # correct token: agent registers, client queries
+        agent = Agent("pem1", "127.0.0.1", broker.port, store=_mkstore(3),
+                      heartbeat_s=0.2, auth_token="s3cret").start()
+        ok = Client("127.0.0.1", broker.port, timeout_s=30.0,
+                    auth_token="s3cret")
+        res = ok.execute_script(SCRIPT)["out"]
+        assert res.to_pandas()["cnt"].sum() > 0
+        ok.close()
+        agent.stop()
+    finally:
+        broker.stop()
+
+
+def test_tracepoint_cannot_clobber_core_table():
+    """ADVICE r2: a tracepoint whose table_name collides with an existing
+    non-tracepoint table must be rejected, not drop the table."""
+    from pixie_tpu.services.tracepoints import TracepointManager
+
+    ts = _mkstore(4)
+    n_before = ts.table("http_events").cursor().num_rows()
+    mgr = TracepointManager(ts)
+    with pytest.raises(InvalidArgument):
+        mgr.upsert({
+            "name": "evil", "table_name": "http_events",
+            "program": "x", "ttl_ns": 10**12,
+            "schema": [{"name": "time_", "type": int(DT.TIME64NS)},
+                       {"name": "x", "type": int(DT.INT64)}],
+        })
+    assert ts.table("http_events").cursor().num_rows() == n_before
+
+
+def test_wire_rejects_overflowing_shape():
+    """ADVICE r2: adversarial shape whose int64 product wraps must be caught
+    as InvalidArgument, not blow up in reshape."""
+    import json as _json
+
+    # shape whose int64-wrapped product is 0: the old int(np.prod(shape))
+    # check passed (0*itemsize == nbytes == 0) and reshape blew up with a
+    # bare ValueError; the checked-int product rejects it up front.
+    hdr = {"kind": "host_batch",
+           "meta": {"dtypes": {"x": 2}, "dicts": {}, "order": ["x"]},
+           "bufs": [{"name": "x", "dtype": "<i8", "nbytes": 0,
+                     "shape": [2**62, 4]}]}
+    hb = _json.dumps(hdr).encode()
+    frame = wire._HDR.pack(wire.MAGIC, len(hb)) + hb
+    with pytest.raises(InvalidArgument):
+        wire.decode_frame(frame)
+
+
+def test_tracepoint_cannot_clobber_other_tracepoints_table():
+    from pixie_tpu.services.tracepoints import TracepointManager
+
+    ts = TableStore()
+    mgr = TracepointManager(ts)
+    schema = [{"name": "time_", "type": int(DT.TIME64NS)},
+              {"name": "x", "type": int(DT.INT64)}]
+    mgr.upsert({"name": "a", "table_name": "t", "program": "p",
+                "ttl_ns": 10**12, "schema": schema})
+    with pytest.raises(InvalidArgument):
+        mgr.upsert({"name": "b", "table_name": "t", "program": "p",
+                    "ttl_ns": 10**12, "schema": schema})
+    # same tracepoint redeploying its own table is fine (TTL refresh)
+    mgr.upsert({"name": "a", "table_name": "t", "program": "p",
+                "ttl_ns": 10**12, "schema": schema})
