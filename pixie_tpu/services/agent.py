@@ -8,6 +8,7 @@ PEM wiring of collector→store mirrors pem/pem_manager.cc:47.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Optional
@@ -195,6 +196,28 @@ def main(argv=None):
             from pixie_tpu.collect.access_log import AccessLogConnector
 
             collector.register(AccessLogConnector(cname.split(":", 1)[1]))
+        elif cname.startswith("capture:"):
+            # Replay a socket-event capture through the protocol parsers
+            # (socket_tracer): capture:/path/to/capture.jsonl
+            from pixie_tpu.collect.tracer import (
+                CaptureFileSource,
+                SocketTraceConnector,
+            )
+
+            path = cname.split(":", 1)[1]
+            collector.register(SocketTraceConnector(
+                CaptureFileSource(path), name=f"socket_tracer:{path}"))
+        elif cname.startswith("tap:"):
+            # Live tap proxy: tap:<listen_port>:<upstream_host>:<upstream_port>
+            # — proxies traffic and traces every connection through it.
+            from pixie_tpu.collect.tap import TapProxy
+            from pixie_tpu.collect.tracer import SocketTraceConnector
+
+            lport, uhost, uport = cname.split(":", 1)[1].split(":")
+            tap = TapProxy(uhost, int(uport), listen_port=int(lport),
+                           pid=os.getpid()).start()
+            collector.register(SocketTraceConnector(
+                tap.source, name=f"socket_tracer:tap:{tap.port}"))
         else:
             raise SystemExit(f"unknown connector {cname!r}")
     agent = Agent(args.name, host, int(port), collector=collector,

@@ -131,10 +131,21 @@ class Broker:
 
     # ------------------------------------------------------------------ frames
     def _on_frame(self, conn: Connection, frame: bytes):
-        kind, payload = wire.decode_frame(frame)
         if self.auth_token is not None and not conn.state.get("authed"):
             import hmac
 
+            # Unauthenticated peers get NO decode work: the only acceptable
+            # first frame is a small auth json.  Oversized or malformed
+            # frames close the connection without allocating for them
+            # (decode_frame would happily materialize a 1GB host_batch).
+            if len(frame) > 4096:
+                conn.close()
+                return
+            try:
+                kind, payload = wire.decode_frame(frame)
+            except Exception:
+                conn.close()
+                return
             # compare_digest over utf-8 bytes: str operands raise TypeError
             # on non-ASCII, which would skip the reject-and-close path.
             if (kind == "json" and payload.get("msg") == "auth"
@@ -150,6 +161,7 @@ class Broker:
                      "error": "authentication required"}))
                 conn.close()
             return
+        kind, payload = wire.decode_frame(frame)
         if kind == "json":
             msg = payload.get("msg")
             if msg == "auth":

@@ -71,8 +71,11 @@ class TracepointManager:
             # trace output to its own new tables.
             owner = next((t.name for t in self._tps.values()
                           if t.table_name == spec["table_name"]), None)
-            if (self.store.has(spec["table_name"])
-                    and owner != spec["name"]):
+            # Another tracepoint owning the name rejects even when the store
+            # lacks the table (kv-restored registry + fresh store after a
+            # broker restart must not let names be stolen).
+            if ((owner is not None and owner != spec["name"])
+                    or (owner is None and self.store.has(spec["table_name"]))):
                 from pixie_tpu.status import InvalidArgument
                 whose = (f"tracepoint {owner!r}" if owner is not None
                          else "a non-tracepoint table")
