@@ -109,27 +109,38 @@ def _http_df(ts):
     return df
 
 
-def _best(fn, repeats):
-    best = float("inf")
-    out = None
+def _times(fn, repeats):
+    """-> (sorted list of wall seconds, last out)."""
+    ts, out = [], None
     for _ in range(repeats):
         t0 = time.perf_counter()
         out = fn()
-        best = min(best, time.perf_counter() - t0)
-    return best, out
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts), out
+
+
+def _best(fn, repeats):
+    ts, out = _times(fn, repeats)
+    return ts[0], out
+
+
+def _p50(ts):
+    return ts[len(ts) // 2]
 
 
 # ------------------------------------------------------------------- configs
 
 
-def bench_config1(ts, rows, repeats):
+def bench_config1(ts, rows, repeats, with_times=False):
     from pixie_tpu.engine import execute_plan
 
     plan = http_plan()
     execute_plan(plan, ts)  # warm-up / compile
-    secs, out = _best(lambda: execute_plan(plan, ts)["output"], repeats)
+    times, out = _times(lambda: execute_plan(plan, ts)["output"], repeats)
     assert out.num_rows > 0
-    return rows / secs
+    if with_times:
+        return rows / times[0], times
+    return rows / times[0]
 
 
 def pandas_config1(ts, rows, repeats):
@@ -362,9 +373,17 @@ def main():
     for n in sorted(sweep_sizes):
         ts = TableStore()
         build_http_table(ts, n)
-        eng = bench_config1(ts, n, args.repeats)
+        # p50 latency over more repeats at interactive sizes — the latency
+        # the reference's exectime benchmark measures
+        # (e2e_test/vizier/exectime/exectime_benchmark.go:47-66)
+        reps = max(args.repeats, 7) if n <= 4_000_000 else args.repeats
+        eng, times = bench_config1(ts, n, reps, with_times=True)
         base = pandas_config1(ts, n, max(1, args.repeats - 1))
-        sweep[str(n)] = {"rows_per_sec": round(eng), "vs_pandas": round(eng / base, 2)}
+        sweep[str(n)] = {
+            "rows_per_sec": round(eng),
+            "vs_pandas": round(eng / base, 2),
+            "p50_ms": round(_p50(times) * 1000, 1),
+        }
         if n == args.rows:
             headline, headline_base = eng, base
             t_secs = n / eng
@@ -402,6 +421,19 @@ def main():
             "achieved_flops_per_sec": round(mxu),
             "mfu_vs_peak": round(mxu / peak, 6),
             "note": "one-hot agg matmul model; scatter/sketch paths excluded",
+        },
+        "roofline": {
+            # config #1 reads 3 pruned columns (service i32 + status i64 +
+            # latency i64) = 20 B/row; HBM peak from v5e spec sheet.
+            "effective_bytes_per_sec": round(headline * 20),
+            "hbm_peak_bytes_per_sec": 8.19e11,
+            "vs_hbm_peak": round(headline * 20 / 8.19e11, 4),
+            "note": (
+                "e2e is bounded by the tunneled runtime's fixed per-device-op "
+                "cost (~100 ms after any D2H readback), not HBM: a warm query "
+                "is 1 execution + 1 readback wave; sizes <= PX_CPU_CROSSOVER_"
+                "ROWS bypass the TPU entirely on the XLA-CPU scatter path"
+            ),
         },
     }
     print(json.dumps(result))

@@ -202,6 +202,11 @@ class ConnTracker:
         stream = self.send if direction == "send" else self.recv
         stream.add_data(data, timestamp_ns)
 
+    #: unmatched frames kept after a stitch round; beyond this the oldest are
+    #: expired (a lost peer event must not wedge the connection at
+    #: MAX_PARSED_FRAMES and halt parsing forever)
+    MAX_PENDING_FRAMES = 1024
+
     def process(self) -> list:
         """Parse both streams and stitch -> list of (record, row_dict)."""
         self.req_stream.process(self.state)
@@ -209,6 +214,10 @@ class ConnTracker:
         records, errors = self.parser.stitch(
             self.req_stream.frames, self.resp_stream.frames, self.state
         )
+        for frames in (self.req_stream.frames, self.resp_stream.frames):
+            while len(frames) > self.MAX_PENDING_FRAMES:
+                frames.popleft()
+                errors += 1
         self.stitch_errors += errors
         self.records_emitted += len(records)
         return records

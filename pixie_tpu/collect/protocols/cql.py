@@ -101,22 +101,24 @@ class CQLParser(ProtocolParser):
         records = []
         errors = 0
         pending = {r.stream: r for r in requests}
-        matched_resp = []
+        matched_req = set()
         for resp in responses:
             if resp.opcode == OP_EVENT:  # server push, no request
-                matched_resp.append(resp)
                 records.append((None, resp))
                 continue
             req = pending.pop(resp.stream, None)
             if req is None:
                 errors += 1
-                matched_resp.append(resp)
                 continue
-            requests.remove(req)
-            matched_resp.append(resp)
+            matched_req.add(id(req))
             records.append((req, resp))
-        for m in matched_resp:
-            responses.remove(m)
+        # Every response resolves this round (matched, push, or orphan);
+        # rebuild the request deque once — O(n), not per-item remove.
+        responses.clear()
+        if matched_req:
+            kept = [r for r in requests if id(r) not in matched_req]
+            requests.clear()
+            requests.extend(kept)
         return records, errors
 
     @staticmethod
@@ -138,9 +140,10 @@ class CQLParser(ProtocolParser):
                 ncols = int.from_bytes(frame.body[8:12], "big")
                 out = f"Rows ({ncols} columns)"
             return out
-        if frame.opcode == OP_ERROR and len(frame.body) >= 4:
-            # [code:4][string message]
-            return _long_string(frame.body[4:]) if len(frame.body) > 8 else ""
+        if frame.opcode == OP_ERROR and len(frame.body) >= 6:
+            # [code:4][message: SHORT string — 2-byte length (spec §3)]
+            n = int.from_bytes(frame.body[4:6], "big")
+            return frame.body[6:6 + n].decode("latin1", "replace")
         if frame.opcode == OP_READY:
             return "READY"
         return ""

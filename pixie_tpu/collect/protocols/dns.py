@@ -145,9 +145,20 @@ class DNSParser(ProtocolParser):
                 got = _read_name(buf, pos - rdlen)
                 ans["cname"] = got[0] if got else ""
             msg.answers.append(ans)
-        # Authority/additional sections are counted in the header but not
-        # decoded into records (reference behavior).
-        return ParseState.SUCCESS, msg, len(buf)
+        # Authority/additional sections are counted in the header and SKIPPED
+        # (not decoded into records — reference behavior), but must still be
+        # walked so `consumed` lands on the true message end: consuming
+        # len(buf) would swallow any further messages queued in the stream.
+        for _ in range(msg.num_auth + msg.num_addl):
+            got = _read_name(buf, pos)
+            if got is None or got[1] + 10 > len(buf):
+                return ParseState.INVALID, None, 0
+            _name, pos = got
+            rdlen = int.from_bytes(buf[pos + 8:pos + 10], "big")
+            pos += 10 + rdlen
+            if pos > len(buf):
+                return ParseState.INVALID, None, 0
+        return ParseState.SUCCESS, msg, pos
 
     # ------------------------------------------------------------- stitching
     def stitch(self, requests, responses, state=None):
@@ -156,20 +167,22 @@ class DNSParser(ProtocolParser):
         by_txid = {}
         for req in requests:
             by_txid.setdefault(req.txid, deque()).append(req)
-        matched_reqs = []
-        matched_resps = []
+        matched_reqs = set()
         for resp in responses:
             q = by_txid.get(resp.txid)
             if not q:
+                errors += 1  # orphan response (request lost / mid-attach)
                 continue
             req = q.popleft()
-            matched_reqs.append(req)
-            matched_resps.append(resp)
+            matched_reqs.add(id(req))
             records.append((req, resp))
-        for m in matched_resps:
-            responses.remove(m)
-        for m in matched_reqs:
-            requests.remove(m)
+        # Rebuild (O(n)) instead of per-item remove (O(n^2)); ALL responses
+        # drain — matched ones are recorded, orphans counted and dropped.
+        responses.clear()
+        if matched_reqs:
+            kept = [r for r in requests if id(r) not in matched_reqs]
+            requests.clear()
+            requests.extend(kept)
         return records, errors
 
     @staticmethod

@@ -83,9 +83,24 @@ def _parse_chunked(buf: bytes, start: int):
         pos += size + 2
 
 
+class _State:
+    """Response body semantics depend on the REQUEST (RFC 9110: HEAD
+    responses carry no body even with Content-Length) — track the in-flight
+    request methods in order.  The request stream is processed before the
+    response stream each round (ConnTracker.process), so order holds."""
+
+    def __init__(self):
+        from collections import deque as _dq
+
+        self.pending_methods = _dq()
+
+
 class HTTPParser(ProtocolParser):
     name = "http"
     table = "http_events"
+
+    def new_state(self):
+        return _State()
 
     def find_frame_boundary(self, msg_type, buf, start, state=None):
         if msg_type is MessageType.RESPONSE:
@@ -131,6 +146,18 @@ class HTTPParser(ProtocolParser):
         msg.headers = _parse_headers(lines[1:])
         body_start = hdr_end + 4
 
+        if not msg.is_request:
+            # Peek (pop happens only on SUCCESS): NEEDS_MORE_DATA re-parses.
+            head_req = (state is not None and state.pending_methods
+                        and state.pending_methods[0] == "HEAD")
+            # Bodiless responses (HEAD, 1xx, 204, 304) end at the headers no
+            # matter what Content-Length claims — waiting for the declared
+            # body would stall the stream forever.
+            if head_req or msg.status in (204, 304) or 100 <= msg.status < 200:
+                if state is not None and state.pending_methods:
+                    state.pending_methods.popleft()
+                return ParseState.SUCCESS, msg, body_start
+
         te = msg.headers.get("transfer-encoding", "")
         if "chunked" in te:
             res = _parse_chunked(buf, body_start)
@@ -152,6 +179,13 @@ class HTTPParser(ProtocolParser):
             end = body_start + clen
         msg.body_size = len(body)
         msg.body = body[:BODY_LIMIT].decode("latin1")
+        # Method bookkeeping only on SUCCESS: partial parses return
+        # NEEDS_MORE_DATA and re-run, which must not double-count.
+        if state is not None:
+            if msg.is_request:
+                state.pending_methods.append(msg.method)
+            elif state.pending_methods:
+                state.pending_methods.popleft()
         return ParseState.SUCCESS, msg, end
 
     def stitch(self, requests, responses, state=None):

@@ -108,20 +108,19 @@ class KafkaParser(ProtocolParser):
         pending: dict[int, KafkaFrame] = {}
         for req in requests:
             pending[req.correlation_id] = req
-        matched_resp = []
-        matched_req = []
+        matched_req = set()
         for resp in responses:
             req = pending.pop(resp.correlation_id, None)
-            matched_resp.append(resp)
             if req is None:
                 errors += 1
                 continue
-            matched_req.append(req)
+            matched_req.add(id(req))
             records.append((req, resp))
-        for m in matched_resp:
-            responses.remove(m)
-        for m in matched_req:
-            requests.remove(m)
+        responses.clear()
+        if matched_req:
+            kept = [r for r in requests if id(r) not in matched_req]
+            requests.clear()
+            requests.extend(kept)
         return records, errors
 
     def record_row(self, record):

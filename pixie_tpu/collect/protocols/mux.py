@@ -69,20 +69,24 @@ class MuxParser(ProtocolParser):
         pending: dict[int, MuxFrame] = {}
         for req in requests:
             pending[req.tag] = req
-        matched_req = []
-        matched_resp = []
+        matched_req = set()
         for resp in responses:
             req = pending.pop(resp.tag, None)
-            matched_resp.append(resp)
-            if req is None or resp.type_ != -req.type_:
+            if req is None:
                 errors += 1
                 continue
-            matched_req.append(req)
+            # The tag is answered either way; a type mismatch is an error
+            # record dropped, and the request must not linger forever.
+            matched_req.add(id(req))
+            if resp.type_ != -req.type_:
+                errors += 1
+                continue
             records.append((req, resp))
-        for m in matched_resp:
-            responses.remove(m)
-        for m in matched_req:
-            requests.remove(m)
+        responses.clear()
+        if matched_req:
+            kept = [r for r in requests if id(r) not in matched_req]
+            requests.clear()
+            requests.extend(kept)
         return records, errors
 
     def record_row(self, record):

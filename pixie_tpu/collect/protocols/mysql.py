@@ -139,19 +139,6 @@ class MySQLParser(ProtocolParser):
                 n_rows += 1
         return RESP_OK, f"Resultset rows = {n_rows}"
 
-    def _response_complete(self, req_cmd: int, resps: list[MySQLPacket]) -> bool:
-        if not resps:
-            return False
-        first = resps[0].payload
-        if first[:1] in (b"\xff", b"\x00") or self._is_eof(first):
-            return True
-        # resultset termination: second EOF/OK after the column-def EOF
-        terminators = sum(
-            1 for p in resps[1:]
-            if self._is_eof(p.payload) or p.payload[:1] == b"\x00"
-        )
-        return terminators >= 2
-
     def stitch(self, requests, responses, state=None):
         records = []
         errors = 0
@@ -167,16 +154,30 @@ class MySQLParser(ProtocolParser):
                 requests.popleft()
                 records.append((req, cmd, RESP_NONE, "", req.timestamp_ns))
                 continue
-            # Collect this command's response run: everything up to the next
-            # request's timestamp (responses arrive strictly after their
-            # request on a single connection).
-            nxt_ts = requests[1].timestamp_ns if len(requests) > 1 else None
+            # This command's response run = the MINIMAL response-packet
+            # prefix that forms a complete response (OK/ERR/EOF or full
+            # resultset).  Packet SHAPE, not timestamps, frames the run:
+            # MySQL serializes responses per connection, so shape framing
+            # stays correct when the client pipelines requests (responses
+            # arriving after the next request's timestamp).
             run = []
+            complete = False
+            terminators = 0
             for p in responses:
-                if nxt_ts is not None and p.timestamp_ns >= nxt_ts:
-                    break
                 run.append(p)
-            if not self._response_complete(cmd, run) and nxt_ts is None:
+                if len(run) == 1:
+                    first = p.payload
+                    if first[:1] in (b"\xff", b"\x00") or self._is_eof(first):
+                        complete = True
+                        break
+                    continue
+                # resultset: column-def EOF then row-section EOF/OK
+                if self._is_eof(p.payload) or p.payload[:1] == b"\x00":
+                    terminators += 1
+                    if terminators >= 2:
+                        complete = True
+                        break
+            if not complete:
                 break  # wait for more response packets
             for _ in run:
                 responses.popleft()

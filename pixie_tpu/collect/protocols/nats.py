@@ -68,21 +68,42 @@ class NATSParser(ProtocolParser):
             end += size + 2
         return ParseState.SUCCESS, frame, end
 
+    def new_state(self):
+        class _State:
+            #: id() of a request already held back one round awaiting its ack
+            held = None
+            #: connection has shown +OK/-ERR acks (CONNECT verbose mode)
+            verbose = False
+
+        return _State()
+
     # ------------------------------------------------------------- stitching
     def stitch(self, requests, responses, state=None):
         """NATS is not strictly request/response: most commands are one-way.
         Each frame (either direction) becomes a record; +OK/-ERR responses
-        attach to the most recent unacked client command (verbose mode) —
-        reference stitcher semantics."""
+        attach to the most recent unacked client command (verbose mode).
+        On VERBOSE connections (ones that have shown acks) the newest
+        unanswered command is held back for one round so an ack landing in
+        the next transfer interval can still attach; non-verbose connections
+        (the common mode — servers never ack) emit immediately."""
         records = []
         errors = 0
+        if state is not None and not state.verbose:
+            state.verbose = any(r.cmd in ("+OK", "-ERR") for r in responses)
         while requests:
-            req = requests.popleft()
+            req = requests[0]
             resp = ""
             if responses and responses[0].cmd in ("+OK", "-ERR") \
                     and responses[0].timestamp_ns >= req.timestamp_ns:
                 r = responses.popleft()
                 resp = r.cmd if not r.args else f"{r.cmd} {' '.join(r.args)}"
+            elif len(requests) == 1 and state is not None and state.verbose \
+                    and state.held != id(req):
+                state.held = id(req)
+                break  # wait one round for a possible late ack
+            requests.popleft()
+            if state is not None and state.held == id(req):
+                state.held = None
             records.append((req, resp))
         while responses:
             r = responses.popleft()

@@ -72,7 +72,12 @@ def _err_fields(payload: bytes) -> str:
 
 class _State:
     def __init__(self):
-        self.startup_done = False
+        self.startup_done = False   # request stream consumed the startup
+        #: response stream saw its first bytes (the SSLRequest answer is a
+        #: single tagless byte and may only be the FIRST thing the server
+        #: sends — keyed per-stream, since the request stream processes
+        #: first each round and must not flip response-side state)
+        self.resp_started = False
 
 
 class PgSQLParser(ProtocolParser):
@@ -106,18 +111,23 @@ class PgSQLParser(ProtocolParser):
                     state.startup_done = True
                 return ParseState.IGNORE, None, ln
             state.startup_done = True  # mid-stream attach: no startup seen
+        # One server byte 'S'/'N' answers SSLRequest with NO length field.
+        # This must be checked BEFORE the tagged-message path ('S' and 'N'
+        # are also valid response tags), keyed on the response stream's OWN
+        # first-bytes state plus an implausible would-be length.
+        if msg_type is MessageType.RESPONSE and state is not None \
+                and not state.resp_started:
+            state.resp_started = True
+            if buf[:1] in (b"S", b"N"):
+                ln_guess = (int.from_bytes(buf[1:5], "big")
+                            if len(buf) >= 5 else -1)
+                if ln_guess < 4 or ln_guess > 1 << 24:
+                    return ParseState.IGNORE, None, 1
         if len(buf) < 5:
             return ParseState.NEEDS_MORE_DATA, None, 0
         tag = buf[:1]
         tags = _REQ_TAGS if msg_type is MessageType.REQUEST else _RESP_TAGS
         if tag not in tags:
-            # One server byte 'S'/'N' answers SSLRequest with no length.
-            if msg_type is MessageType.RESPONSE and state is not None \
-                    and not state.startup_done and tag in (b"S", b"N") \
-                    and len(buf) >= 1:
-                ln_guess = int.from_bytes(buf[1:5], "big") if len(buf) >= 5 else 0
-                if ln_guess > 1 << 24 or ln_guess < 4:
-                    return ParseState.IGNORE, None, 1
             return ParseState.INVALID, None, 0
         ln = int.from_bytes(buf[1:5], "big")
         if ln < 4 or ln > 1 << 24:

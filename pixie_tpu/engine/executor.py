@@ -142,6 +142,72 @@ def _op_sig(op) -> dict:
     return d
 
 
+#: blocking-op intermediates cache kernels by dictionary CONTENT; above this
+#: size fingerprinting costs more than the compile it saves
+CONTENT_SIG_MAX_DICT = 1 << 16
+
+
+def _dict_fingerprint(d) -> int:
+    """Content hash of a Dictionary (process-local; cache is in-process)."""
+    return hash(tuple(str(v) for v in d.values()))
+
+
+# ------------------------------------------------- small-input device policy
+#: content-signature key hashing is O(rows); only hash small intermediates
+SMALL_HOST_INPUT_ROWS = 1 << 15
+
+#: Inputs at or under this row count dispatch on the CPU backend.  Rationale
+#: (measured on the axon-tunneled v5e): after the first device→host readback
+#: the TPU runtime drops PERMANENTLY into a ~100 ms-per-operation synchronous
+#: mode, so every TPU execution/readback costs ~100 ms regardless of size —
+#: while XLA-CPU scatter aggs run 1M rows in ~8 ms.  The crossover where the
+#: TPU's bandwidth wins back the fixed ~200 ms (1 exec + 1 readback) is a few
+#: million rows.  This is ALSO why kernels must minimize executions per query.
+CPU_CROSSOVER_ROWS = _flags.define_int(
+    "PX_CPU_CROSSOVER_ROWS", 1 << 22,
+    "inputs at/below this row count run on the CPU backend",
+)
+
+_CPU_DEVICE: "object" = None  # resolved lazily; False = unavailable
+
+
+def _cpu_device():
+    global _CPU_DEVICE
+    if _CPU_DEVICE is None:
+        try:
+            _CPU_DEVICE = jax.devices("cpu")[0]
+        except Exception:
+            _CPU_DEVICE = False
+    return _CPU_DEVICE
+
+
+def _src_rows(src) -> Optional[int]:
+    if isinstance(src, HostBatch):
+        return src.num_rows
+    try:
+        return src.num_rows()
+    except Exception:
+        return None
+
+
+def _route_backend(src) -> str:
+    n = _src_rows(src)
+    if n is not None and n <= CPU_CROSSOVER_ROWS and \
+            _cpu_device() is not False:
+        return "cpu"
+    return "tpu"
+
+
+def _small_input_device(src):
+    """Context manager routing kernel dispatch to CPU below the crossover.
+    Only uncommitted (numpy) inputs follow the default device, so TPU-cached
+    feeds keep their placement — the context is a preference, not a forced
+    transfer."""
+    if _route_backend(src) == "cpu":
+        return jax.default_device(_cpu_device())
+    return _contextlib.nullcontext()
+
+
 def _iter_call_fns(expr):
     """Yield every Call fn name in an expression tree."""
     if isinstance(expr, Call):
@@ -343,10 +409,12 @@ class ChainKernel:
     def has_limit(self) -> bool:
         return bool(self.limit_ns)
 
-    def init_limits(self) -> jnp.ndarray:
-        """Initial per-limit remaining budgets (shape [max(1, n_limits)])."""
+    def init_limits(self) -> np.ndarray:
+        """Initial per-limit remaining budgets (shape [max(1, n_limits)]).
+        Numpy on purpose: an eager jnp.asarray would be a fixed-cost device
+        op per query; as a jit argument it rides the execution's upload."""
         ns = self.limit_ns or [INT64_MAX]
-        return jnp.asarray(np.asarray(ns, dtype=np.int64))
+        return np.asarray(ns, dtype=np.int64)
 
     @property
     def luts(self) -> dict[str, np.ndarray]:
@@ -460,19 +528,42 @@ class ChainKernel:
 
         return jax.jit(merge)
 
+    @staticmethod
+    def make_merge_states_np(udas):
+        """→ fn(*numpy_states) → merged numpy state, on HOST.  Per-feed
+        partials are pulled in one overlapped readback wave and merged here:
+        a device-side merge would cost one more execution, and on the tunneled
+        runtime every execution is a fixed ~100 ms round-trip."""
+        reduce_tree = {name: uda.reduce_ops() for name, uda, _vb in udas}
+        fns = {"add": (lambda *ls: np.sum(np.stack(ls), axis=0)),
+               "min": (lambda *ls: np.min(np.stack(ls), axis=0)),
+               "max": (lambda *ls: np.max(np.stack(ls), axis=0))}
+
+        def merge(*states):
+            if len(states) == 1:
+                return states[0]
+            return jax.tree.map(
+                lambda op, *leaves: fns[op](*leaves),
+                reduce_tree,
+                *states,
+                is_leaf=lambda x: isinstance(x, str),
+            )
+
+        return merge
+
     def make_agg_step(self, keys: list[GroupKey], udas: list, num_groups: int, jit: bool = True):
         """→ jit fn(cols, n_valid, t_lo, t_hi, limit_remaining, luts, state)
         → (state, count). udas: list of (out_name, UDA, value_builder|None)."""
-        from pixie_tpu.ops.groupby import combine_codes
+        from pixie_tpu.ops.groupby import combine_codes, encode_against
 
         key_builders = []
         for k in keys:
             if k.kind == "intdevice":
                 src_name, lut_name = k.src_name, k.lut_name
                 key_builders.append(
-                    lambda env, s=src_name, l=lut_name: jnp.searchsorted(
+                    lambda env, s=src_name, l=lut_name: encode_against(
                         env["luts"][l], env["cols"][s]
-                    ).astype(jnp.int32)
+                    )
                 )
             elif k.kind == "dict":
                 key_builders.append(k.key_sval.build)
@@ -527,6 +618,68 @@ def _first_len(cols: dict) -> int:
     for v in cols.values():
         return v.shape[0]
     return 0
+
+
+# ------------------------------------------------------------ column pruning
+def _expr_columns(e) -> set:
+    from pixie_tpu.plan.plan import Call, Column
+
+    if isinstance(e, Column):
+        return {e.name}
+    if isinstance(e, Call):
+        out = set()
+        for a in e.args:
+            out |= _expr_columns(a)
+        return out
+    return set()
+
+
+def _prune_to_needed(head, chain, dtypes, dicts, names, visible, time_col,
+                     needed_end: set):
+    """Narrow the feed (and the chain's Map projections) to the columns the
+    consumer actually reads.  Feeding unused columns wastes host→device
+    bandwidth and, on the CPU route, memcpy + mask work per query (the
+    compiler prunes PxL plans, but hand-built / remote plans arrive
+    unpruned).  The hidden time column stays whenever the source has time
+    bounds (names carries it beyond `visible` in that case).
+
+    Returns (dtypes, dicts, names, visible, chain') — chain' has Map exprs
+    for dropped outputs removed, since the kernel evaluates every listed
+    expr (an unneeded expr over a pruned input would fail to resolve).
+    """
+    chain, req = _chain_required_columns(chain, set(needed_end))
+    keep_visible = [n for n in visible if n in req]
+    if not keep_visible and visible:
+        keep_visible = [visible[0]]  # row count still needs one column
+    keep = list(keep_visible)
+    has_bounds = (getattr(head, "start_time", None) is not None
+                  or getattr(head, "stop_time", None) is not None)
+    if has_bounds and time_col is not None and time_col not in keep \
+            and time_col in names:
+        keep.append(time_col)
+    dtypes = {n: dtypes[n] for n in keep}
+    dicts = {n: dicts[n] for n in keep if n in dicts}
+    return dtypes, dicts, keep, keep_visible, chain
+
+
+def _chain_required_columns(chain, needed: set):
+    """Backward dataflow through Map (full-list projection semantics) and
+    Filter: -> (pruned_chain, required_source_columns)."""
+    new_rev = []
+    for op in reversed(chain):
+        if isinstance(op, MapOp):
+            defined = {name for name, _ in op.exprs}
+            kept = [(name, ex) for name, ex in op.exprs if name in needed]
+            out = set()
+            for _name, ex in kept:
+                out |= _expr_columns(ex)
+            needed = out | (needed - defined)
+            op = (dataclasses.replace(op, exprs=kept)
+                  if len(kept) != len(op.exprs) else op)
+        elif isinstance(op, FilterOp):
+            needed = needed | _expr_columns(op.expr)
+        new_rev.append(op)
+    return list(reversed(new_rev)), needed
 
 
 # -------------------------------------------------------------------- executor
@@ -663,7 +816,8 @@ class PlanExecutor:
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
     # ------------------------------------------------------------- stream feed
-    def _feed(self, src, names, cap, spmd: bool = False):
+    def _feed(self, src, names, cap, spmd: bool = False,
+              backend: str = "tpu"):
         """Yield (cols np dict padded, n_valid) host batches.
 
         Cursor batches (storage granularity) are coalesced into ~FEED_ROWS
@@ -693,8 +847,11 @@ class PlanExecutor:
         def emit(parts, gens, n):
             # Sealed-only feeds are immutable → serve/place them from the HBM
             # feed cache; anything touching the hot remainder streams fresh.
+            # CPU-routed queries keep feeds as (cached) numpy — device_put to
+            # TPU would commit the inputs there and defeat the routing.
             cacheable = all(g is not None for g in gens)
-            dkey = (table_id, tuple(gens), tuple(names), n_dev) if cacheable else None
+            dkey = ((table_id, tuple(gens), tuple(names), n_dev, backend)
+                    if cacheable else None)
             if dkey is not None:
                 cached = _device_cache_get(dkey)
                 if cached is not None:
@@ -716,7 +873,9 @@ class PlanExecutor:
                     off += len(a)
                 cols[k] = buf
             if dkey is not None:
-                if n_dev > 1 and bucket % n_dev == 0:
+                if backend == "cpu":
+                    dev = cols  # host arrays ARE the cpu-backend feed
+                elif n_dev > 1 and bucket % n_dev == 0:
                     from jax.sharding import NamedSharding, PartitionSpec as P
                     from pixie_tpu.parallel.spmd import AGENT_AXIS
 
@@ -789,15 +948,34 @@ class PlanExecutor:
     ) -> Optional[str]:
         """Cache signature for a kernel over this chain; None = not cacheable.
 
-        Only table-headed chains are cached: their dictionaries are append-only,
-        so (id, size) pins exact content (the table uid keeps id() stable).
-        Blocking-op intermediates get fresh dictionaries per query and must not
-        be cached.  Source time bounds are RUNTIME args (t_lo/t_hi), so they are
-        excluded from the signature unless the kernel bakes them (window aggs) —
+        Table-headed chains: dictionaries are append-only, so (id, size) pins
+        exact content (the table uid keeps id() stable).  Blocking-op heads
+        (join/agg intermediates) get FRESH dictionary objects per query, so
+        identity can't pin them — they cache by dictionary CONTENT fingerprint
+        instead (small dicts only; hashing a huge dict would cost more than
+        the compile it saves).  Without this, every query re-jits its
+        post-join/post-agg kernels — the dominant cost of multi-stage plans.
+        Source time bounds are RUNTIME args (t_lo/t_hi), so they are excluded
+        from the signature unless the kernel bakes them (window aggs) —
         otherwise every '-5m'-style relative query would re-jit.
         """
         if not isinstance(head, MemorySourceOp):
-            return None
+            if any(d.size > CONTENT_SIG_MAX_DICT for d in dicts.values()):
+                return None
+            key = {
+                "reg": self.registry.uid,
+                "head": "blocking",
+                "chain": [_op_sig(op) for op in chain],
+                "dtypes": {n: int(t) for n, t in dtypes.items()},
+                "dicts": {n: (d.size, _dict_fingerprint(d))
+                          for n, d in dicts.items()},
+                "extra": extra,
+            }
+            if _chain_uses_volatile(chain, self.registry):
+                from pixie_tpu.metadata import state as _mdstate
+
+                key["md_epoch"] = _mdstate.global_manager().epoch
+            return _json.dumps(key, sort_keys=True, default=str)
         table = self.store.table(head.table)
         src_sig = _op_sig(head)
         # Row-id bounds are pure runtime cursor state (streaming resume
@@ -850,6 +1028,11 @@ class PlanExecutor:
             return out_dtypes, out_dicts, sel, gen_direct()
 
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
+        if out_names is not None:
+            dtypes, dicts, names, visible, chain = _prune_to_needed(
+                head, chain, dtypes, dicts, names, visible, time_col,
+                set(out_names),
+            )
         sig = self._chain_cache_sig(
             head, chain, dtypes, dicts,
             ("out", tuple(out_names) if out_names is not None else None),
@@ -875,12 +1058,13 @@ class PlanExecutor:
             # exactly two round-trips — one packed pull of the row counts, one
             # packed pull of the count-sliced outputs.  With a remote TPU each
             # readback costs a fixed RTT, so per-feed pulls would dominate.
-            with self._timed(label, op_ids) as rec:
+            with self._timed(label, op_ids) as rec, _small_input_device(src):
                 has_limit = kern.has_limit
                 remaining = kern.init_limits()
                 feeds = []
                 feed_ns = []
-                for cols, n_valid in self._feed(src, names, cap):
+                for cols, n_valid in self._feed(src, names, cap,
+                                                backend=_route_backend(src)):
                     tf0 = _time.perf_counter_ns()
                     outs, cnt, consumed = step(
                         cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
@@ -1083,7 +1267,7 @@ class PlanExecutor:
         gid_np = np.searchsorted(uniq_comp, comp).clip(0, Gb - 1).astype(np.int32)
 
         # ---- device reduction over exact gids, chunked.
-        udas, in_types, state = [], {}, {}
+        udas, in_types, init_pairs = [], {}, []
         val_dicts: dict[str, Dictionary] = {}
         dict_val_cols: set[str] = set()
         for ae in op.values:
@@ -1106,7 +1290,7 @@ class PlanExecutor:
             elif not uda.nullary:
                 raise CompilerError(f"aggregate {ae.fn} requires an input column")
             udas.append((ae.out_name, uda, ae.arg))
-            state[ae.out_name] = uda.init(Gb, in_dt)
+            init_pairs.append((ae.out_name, uda, in_dt))
         val_names = sorted({vn for _o, _u, vn in udas if vn is not None})
         # null codes must never win the picker's min-reduction
         for vn in dict_val_cols:
@@ -1136,7 +1320,12 @@ class PlanExecutor:
 
             upd = jax.jit(upd, donate_argnums=(0,))
             _cache_put(_json.dumps(upd_key), (upd, udas))
-        with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]):
+        with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]), \
+                _small_input_device(hb):
+            # state init happens inside the device context so the donated
+            # accumulators live on the dispatch device (CPU for small batches)
+            state = {name: uda.init(Gb, in_dt)
+                     for name, uda, in_dt in init_pairs}
             for off in range(0, n, SORT_AGG_CHUNK):
                 end = min(off + SORT_AGG_CHUNK, n)
                 bucket = max(next_pow2(end - off), MIN_BUCKET)
@@ -1213,6 +1402,11 @@ class PlanExecutor:
         finalize path and the distributed partial path)."""
         head, chain = self._upstream_chain(self.plan.parents(op)[0])
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
+        needed = set(op.groups) | {ae.arg for ae in op.values
+                                   if ae.arg is not None}
+        dtypes, dicts, names, visible, chain = _prune_to_needed(
+            head, chain, dtypes, dicts, names, visible, time_col, needed,
+        )
 
         # Agg kernels bake data-dependent key sets (intdevice uniques, window
         # origins) unless every group key is dictionary-backed; cover that with
@@ -1240,6 +1434,36 @@ class PlanExecutor:
             fb_sig = self._chain_cache_sig(
                 head, chain, dtypes, dicts, ["agg_fallback", _op_sig(op)]
             )
+        else:
+            # Blocking-op-headed agg (e.g. the post-join re-aggregation):
+            # content-keyed caching.  Non-dict group keys bake their unique
+            # value sets into the kernel, so their column content joins the
+            # signature (small host batches only — hashing is O(rows)).
+            extra = ["agg", _op_sig(op),
+                     ("mesh", self.mesh.size if self.mesh else 0)]
+            cacheable = True
+            non_dict = [g for g in op.groups if g not in dicts]
+            if non_dict:
+                # Computed keys derive from source columns through the chain;
+                # hashing the REQUIRED source columns pins the baked value
+                # sets regardless of where in the chain the key is built.
+                if (isinstance(src, HostBatch)
+                        and src.num_rows <= SMALL_HOST_INPUT_ROWS):
+                    _unused, req = _chain_required_columns(chain, set(non_dict))
+                    for c in sorted(req):
+                        if c in src.cols:
+                            extra.append(
+                                ("keyhash", c, hash(src.cols[c].tobytes())))
+                        else:
+                            cacheable = False
+                            break
+                else:
+                    cacheable = False
+            if cacheable:
+                sig = self._chain_cache_sig(head, chain, dtypes, dicts, extra)
+                fb_sig = self._chain_cache_sig(
+                    head, chain, dtypes, dicts,
+                    ["agg_fallback", _op_sig(op)])
         if _cache_get(fb_sig) == "group_key_fallback":
             raise GroupKeyFallback(f"agg {op.id}: cached fallback decision")
         for _attempt in range(2):
@@ -1262,17 +1486,22 @@ class PlanExecutor:
                 "window-bin bucket overflowed twice (concurrent ingest "
                 "outpacing kernel rebuild); retry the query"
             )
-        state = {name: uda.init(num_groups, in_dt) for name, uda, in_dt in init_specs}
-        t_lo, t_hi = _time_bounds(head)
-        luts = {**kern.luts, **lut_over} if lut_over else kern.luts
-        with self._timed(
-            self._chain_label(head, chain, "partial_agg"),
-            ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
-        ):
-            state_np = self._agg_feed_loop(
-                kern, step, partial_step, merge_fn, spmd_step, state,
-                src, names, cap, t_lo, t_hi, luts,
-            )
+        # Small host-batch inputs dispatch on the CPU backend (compile is the
+        # dominant cost at this scale); the SPMD path stays on the mesh.
+        dev_ctx = (_small_input_device(src) if spmd_step is None
+                   else _contextlib.nullcontext())
+        with dev_ctx:
+            t_lo, t_hi = _time_bounds(head)
+            luts = {**kern.luts, **lut_over} if lut_over else kern.luts
+            with self._timed(
+                self._chain_label(head, chain, "partial_agg"),
+                ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
+            ):
+                state_np = self._agg_feed_loop(
+                    kern, step, partial_step, merge_fn, spmd_step,
+                    init_specs, num_groups,
+                    src, names, cap, t_lo, t_hi, luts,
+                )
         return keys, udas, state_np, seen_name, in_types, val_dicts
 
     def _refresh_window_keys(self, keys, src, head):
@@ -1364,7 +1593,7 @@ class PlanExecutor:
 
         step = kern.make_agg_step(keys, udas, num_groups)
         partial_step = kern.make_partial_agg_step(keys, udas, num_groups, init_specs)
-        merge_fn = kern.make_merge_states(udas)
+        merge_fn = kern.make_merge_states_np(udas)
         spmd_step = None
         if self.mesh is not None:
             from pixie_tpu.parallel.spmd import reduce_tree_for, spmd_partial_step
@@ -1385,13 +1614,25 @@ class PlanExecutor:
         return bundle
 
     def _agg_feed_loop(self, kern, step, partial_step, merge_fn, spmd_step,
-                       state, src, names, cap, t_lo, t_hi, luts):
-        """Drive the feeds through the agg step and pull the final state."""
+                       init_specs, num_groups, src, names, cap, t_lo, t_hi,
+                       luts):
+        """Drive the feeds through the agg step and pull the final state.
+
+        State init is LAZY: creating identity state eagerly would dispatch
+        one device op per UDA leaf before any feed runs — fixed-cost ops the
+        tunneled runtime bills at ~100 ms each.  The partial path inits
+        inside its trace; only the budget-threaded limit path (and the
+        no-feed fallback) materializes identities here.
+        """
+        state = None
         if kern.has_limit:
             # Limit queries must thread the budgets, so the feed steps chain;
             # the budgets stay a device vector (no per-feed host sync).
+            state = {name: uda.init(num_groups, in_dt)
+                     for name, uda, in_dt in init_specs}
             remaining = kern.init_limits()
-            for cols, n_valid in self._feed(src, names, cap):
+            for cols, n_valid in self._feed(src, names, cap,
+                                            backend=_route_backend(src)):
                 state, cnt, consumed = step(
                     cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
                 )
@@ -1400,16 +1641,19 @@ class PlanExecutor:
                     jax.block_until_ready(state)
         else:
             # No limit → per-feed partials are INDEPENDENT executions (init
-            # inside the trace), merged in one stacked reduction.  Dependent
-            # executions serialize badly on remote runtimes; this keeps the
-            # device pipeline flat: N parallel steps + 1 merge + 1 readback.
-            # With a mesh, each feed shards row-wise over ALL devices and
-            # merges per-device state in-program via psum/pmin/pmax (the
-            # reference's PEM-partial → Kelvin-finalize, but over ICI).
+            # inside the trace).  Dependent executions serialize badly on
+            # remote runtimes; this keeps the device pipeline flat: N parallel
+            # steps + ONE overlapped readback wave + a HOST merge (a device
+            # merge would be one more fixed-cost execution).  With a mesh,
+            # each feed shards row-wise over ALL devices and merges
+            # per-device state in-program via psum/pmin/pmax (the reference's
+            # PEM-partial → Kelvin-finalize, but over ICI).
             partials = []
             n_dev = self.mesh.size if self.mesh is not None else 1
+            backend = "tpu" if spmd_step is not None else _route_backend(src)
             for cols, n_valid in self._feed(src, names, cap,
-                                            spmd=spmd_step is not None):
+                                            spmd=spmd_step is not None,
+                                            backend=backend):
                 bucket = _first_len(cols)
                 if spmd_step is not None and bucket % n_dev == 0:
                     from pixie_tpu.parallel.spmd import per_shard_valid
@@ -1418,16 +1662,29 @@ class PlanExecutor:
                     partials.append(spmd_step(cols, nv, t_lo, t_hi, luts))
                     self.stats["spmd_feeds"] = self.stats.get("spmd_feeds", 0) + 1
                 else:
-                    partials.append(
-                        partial_step(cols, np.int64(n_valid), t_lo, t_hi, luts)
-                    )
+                    # A small NUMPY feed (typically the hot remainder of a
+                    # big table) dispatches on CPU even in a TPU-routed
+                    # query: it would otherwise cost one more fixed-price
+                    # TPU execution; the host merge unifies the partials.
+                    first = next(iter(cols.values()))
+                    small_np = (isinstance(first, np.ndarray)
+                                and bucket <= CPU_CROSSOVER_ROWS
+                                and _cpu_device() is not False)
+                    ctx = (jax.default_device(_cpu_device()) if small_np
+                           else _contextlib.nullcontext())
+                    with ctx:
+                        partials.append(
+                            partial_step(cols, np.int64(n_valid), t_lo, t_hi,
+                                         luts)
+                        )
                 if self.analyze:
                     jax.block_until_ready(partials[-1])
-            if len(partials) == 1:
-                state = partials[0]
-            elif partials:
-                state = merge_fn(*partials)
+            if partials:
+                return merge_fn(*transfer.pull(partials))
 
+        if state is None:  # no feeds at all: identity state
+            state = {name: uda.init(num_groups, in_dt)
+                     for name, uda, in_dt in init_specs}
         return transfer.pull(state)
 
     def _decode_key_column(self, k: GroupKey, codes: np.ndarray):

@@ -55,9 +55,36 @@ MATMUL_MAX_GROUPS = 1 << 10
 CHUNK_ROWS = 1 << 16
 
 
+def dispatch_backend() -> str:
+    """The platform kernels traced right now will run on.
+
+    `jax.default_backend()` ignores an active `jax.default_device(...)`
+    override (the executor routes small queries to CPU that way), so consult
+    the config var first.  Formulation choices (MXU one-hot vs scatter) must
+    follow the DISPATCH platform or CPU-routed aggs would trace the matmul
+    path — measured 3.6 s vs 8 ms for 1M rows on CPU.
+    """
+    d = jax.config.jax_default_device
+    if d is not None:
+        return d.platform
+    return jax.default_backend()
+
+
+def encode_against(lut: jax.Array, values: jax.Array) -> jax.Array:
+    """value → sorted-LUT position (== jnp.searchsorted(lut, values, 'left')).
+
+    Small LUTs use a broadcast compare-count: XLA CPU lowers searchsorted to
+    a sequential scan (~17 ms for 1M rows × 5 entries, measured) while the
+    [N, K] compare is vectorized (~1 ms); TPU fuses either form.
+    """
+    if lut.shape[0] <= 64 and dispatch_backend() != "tpu":
+        return jnp.sum(lut[None, :] < values[:, None], axis=1).astype(jnp.int32)
+    return jnp.searchsorted(lut, values).astype(jnp.int32)
+
+
 def _use_matmul(n: int, num_groups: int) -> bool:
     return (
-        jax.default_backend() == "tpu"
+        dispatch_backend() == "tpu"
         and num_groups <= MATMUL_MAX_GROUPS
         and n >= 4096
         and (n % min(n, CHUNK_ROWS)) == 0

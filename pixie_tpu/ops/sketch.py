@@ -62,7 +62,9 @@ class LogHistogram:
         n = gid.shape[0]
         bins = self.bin_index(values)
         ch = min(n, self.CHUNK)
-        if jax.default_backend() == "tpu" and num_groups <= 4096 and n >= 4096 and n % ch == 0:
+        from pixie_tpu.ops.groupby import dispatch_backend
+
+        if dispatch_backend() == "tpu" and num_groups <= 4096 and n >= 4096 and n % ch == 0:
             g32 = gid.astype(jnp.int32)
             m32 = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
             c = n // ch

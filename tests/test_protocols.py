@@ -334,6 +334,84 @@ class TestRedis:
         assert tr.parser.record_row(recs[0])["resp"] == "42"
 
 
+class TestReviewRegressions:
+    """Regressions for stitcher/parser bugs found in review."""
+
+    def test_dns_two_messages_one_chunk(self):
+        tr = ConnTracker(DNSParser(), role=ConnTracker.ROLE_SERVER)
+        # both queries arrive in ONE data event; both must parse
+        tr.add_data("recv", dns_query(1, "a.com") + dns_query(2, "b.com"), 10)
+        tr.add_data("send", dns_response(1, "a.com", "1.1.1.1")
+                    + dns_response(2, "b.com", "2.2.2.2"), 20)
+        recs = tr.process()
+        assert len(recs) == 2
+
+    def test_http_head_response_with_content_length(self):
+        tr = ConnTracker(HTTPParser(), role=ConnTracker.ROLE_SERVER)
+        # pipelined: both requests observed before the responses
+        tr.add_data("recv", b"HEAD /x HTTP/1.1\r\nHost: t\r\n\r\n", 1)
+        tr.add_data("recv", b"GET /y HTTP/1.1\r\nHost: t\r\n\r\n", 2)
+        # HEAD reply declares a length but never sends a body (RFC 9110)
+        tr.add_data("send", b"HTTP/1.1 200 OK\r\nContent-Length: 1234\r\n\r\n"
+                    b"HTTP/1.1 404 NF\r\nContent-Length: 0\r\n\r\n", 3)
+        recs = tr.process()
+        assert len(recs) == 2
+        rows = [tr.parser.record_row(r) for r in recs]
+        assert rows[0]["req_method"] == "HEAD"
+        assert rows[0]["resp_status"] == 200
+        assert rows[1]["resp_status"] == 404
+
+    def test_http_304_no_body(self):
+        p = HTTPParser()
+        resp = b"HTTP/1.1 304 Not Modified\r\nContent-Length: 99\r\n\r\n"
+        st, frame, consumed = p.parse_frame(MessageType.RESPONSE, resp)
+        assert st is ParseState.SUCCESS and consumed == len(resp)
+
+    def test_cql_error_short_string(self):
+        from pixie_tpu.collect.protocols.cql import CQLParser, OP_ERROR, OP_QUERY
+
+        tr = ConnTracker(CQLParser(), role=ConnTracker.ROLE_SERVER)
+        q = struct.pack(">i", 1) + b"x"
+        tr.add_data("recv", cql_frame(False, 3, OP_QUERY, q), 1)
+        msg = b"Invalid query"
+        body = struct.pack(">i", 0x2200) + struct.pack(">H", len(msg)) + msg
+        tr.add_data("send", cql_frame(True, 3, OP_ERROR, body), 2)
+        recs = tr.process()
+        assert tr.parser.record_row(recs[0])["resp_body"] == "Invalid query"
+
+    def test_mysql_pipelined_requests(self):
+        tr = ConnTracker(MySQLParser(), role=ConnTracker.ROLE_SERVER)
+        # two queries sent back-to-back BEFORE any response arrives
+        tr.add_data("recv", mysql_packet(0, bytes([COM_QUERY]) + b"Q1")
+                    + mysql_packet(0, bytes([COM_QUERY]) + b"Q2"), 10)
+        tr.add_data("send", mysql_packet(1, b"\x00\x01\x00\x00\x00")
+                    + mysql_packet(1, b"\x00\x02\x00\x00\x00"), 50)
+        recs = tr.process()
+        assert len(recs) == 2
+        rows = [tr.parser.record_row(r) for r in recs]
+        assert rows[0]["req_body"] == "Q1" and rows[1]["req_body"] == "Q2"
+        assert all(r["resp_status"] == RESP_OK for r in rows)
+
+    def test_pgsql_ssl_negotiation(self):
+        tr = ConnTracker(PgSQLParser(), role=ConnTracker.ROLE_SERVER)
+        tr.add_data("recv", struct.pack(">iI", 8, 80877103), 1)  # SSLRequest
+        tr.add_data("send", b"N", 2)  # server declines TLS, no length byte
+        params = b"user\x00u\x00"
+        tr.add_data("recv", struct.pack(">iI", 8 + len(params), 196608) + params, 3)
+        tr.add_data("recv", pg_msg(b"Q", b"SELECT 1;\x00"), 4)
+        tr.add_data("send", pg_msg(b"C", b"SELECT 1\x00") + pg_msg(b"Z", b"I"), 5)
+        recs = tr.process()
+        assert len(recs) == 1
+        assert tr.resp_stream.invalid_frames == 0
+
+    def test_unmatched_frames_expire(self):
+        tr = ConnTracker(KafkaParser(), role=ConnTracker.ROLE_SERVER)
+        for i in range(1500):  # responses whose requests were never seen
+            tr.add_data("send", kafka_resp(i), i)
+        tr.process()
+        assert len(tr.resp_stream.frames) <= tr.MAX_PENDING_FRAMES
+
+
 # -------------------------------------------------------------------- CQL
 class TestCQL:
     def test_query_rows(self):
