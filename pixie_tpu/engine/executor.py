@@ -146,6 +146,77 @@ def _op_sig(op) -> dict:
 #: size fingerprinting costs more than the compile it saves
 CONTENT_SIG_MAX_DICT = 1 << 16
 
+#: (table uid, column) → (sorted unique values, scanned-to row id).  Tables
+#: are append-only (expiry only drops rows), so the set is maintained
+#: incrementally: each refresh scans only rows past the watermark.  This
+#: keys intdevice agg kernels by VALUE-SET CONTENT instead of rows_written —
+#: a streaming poll with no new key values then reuses the compiled kernel
+#: instead of rebuilding it every poll.
+_KEY_UNIQUES: "_collections.OrderedDict[tuple, tuple]" = _collections.OrderedDict()
+_KEY_UNIQUES_MAX = 64
+#: beyond this cardinality the set stops being tracked (the agg would take
+#: the sorted fallback anyway); monotonic, so the overflow mark is permanent
+_KEY_UNIQUES_CAP = MAX_GROUPS
+_KEY_OVERFLOW = "overflow"
+
+
+def _int_key_uniques(table, col: str, src) -> Optional[np.ndarray]:
+    """Cumulative sorted unique values of `col`, scanned from THIS query's
+    snapshot cursor past the cached row-id watermark.
+
+    Scanning the live table instead of the snapshot would race ring-buffer
+    expiry: a value pinned in the query's feed could be missing from the
+    fresh scan and searchsorted would silently fold its rows into a
+    neighboring group.  Rows are immutable and row ids monotone, so values
+    below the watermark were observed live by the scan that covered them —
+    any later snapshot's old rows are a subset.  Returns None when the set
+    overflows _KEY_UNIQUES_CAP (caller falls back to per-query prescan /
+    sorted agg).
+    """
+    key = (table.uid, col)
+    with _CACHE_LOCK:
+        vals, hi = _KEY_UNIQUES.get(key, (None, 0))
+    if vals is _KEY_OVERFLOW:
+        return None
+    parts = [] if vals is None else [vals]
+    seen_hi = hi
+    changed = vals is None
+    for rb, rid, _gen in src:
+        end = rid + rb.num_valid
+        if end <= hi:
+            continue
+        lo = max(0, hi - rid)
+        arr = rb.columns[col][lo: rb.num_valid]
+        if len(arr):
+            parts.append(np.unique(arr))
+            changed = True
+        seen_hi = max(seen_hi, end)
+    if changed:
+        vals = (np.unique(np.concatenate(parts)) if parts
+                else np.empty(0, dtype=np.int64))
+        with _CACHE_LOCK:
+            if len(vals) > _KEY_UNIQUES_CAP:
+                _KEY_UNIQUES[key] = (_KEY_OVERFLOW, seen_hi)
+                return None
+            _KEY_UNIQUES[key] = (vals, seen_hi)
+            while len(_KEY_UNIQUES) > _KEY_UNIQUES_MAX:
+                _KEY_UNIQUES.popitem(last=False)
+    return vals
+
+
+def _group_source_column(chain, name: str):
+    """Resolve a group name back through chain Maps to a direct source
+    column name, or None if it is computed (any non-rename expression)."""
+    from pixie_tpu.plan.plan import Column
+
+    for op in reversed(chain):
+        if isinstance(op, MapOp):
+            e = next((ex for n, ex in op.exprs if n == name), None)
+            if not isinstance(e, Column):
+                return None
+            name = e.name
+    return name
+
 
 def _dict_fingerprint(d) -> int:
     """Content hash of a Dictionary (process-local; cache is in-process)."""
@@ -849,7 +920,8 @@ class PlanExecutor:
             # feed cache; anything touching the hot remainder streams fresh.
             # CPU-routed queries keep feeds as (cached) numpy — device_put to
             # TPU would commit the inputs there and defeat the routing.
-            cacheable = all(g is not None for g in gens)
+            cacheable = (all(g is not None for g in gens)
+                         and not getattr(src, "is_delta", False))
             dkey = ((table_id, tuple(gens), tuple(names), n_dev, backend)
                     if cacheable else None)
             if dkey is not None:
@@ -1169,12 +1241,24 @@ class PlanExecutor:
                     raise GroupKeyFallback(
                         f"group key {name!r} is a computed numeric column"
                     )
-                # Device-side encoding: one prescan finds the uniques (sorted,
-                # so dictionary code == sorted position); the kernel then maps
-                # value→code with a searchsorted against a small device array —
-                # no per-batch host encode (the former 'intdict' hot-loop cost).
+                # Device-side encoding: the uniques come from the per-table
+                # incremental union when available (matches the kernel-cache
+                # signature and costs O(new rows)); otherwise one prescan
+                # over this query's cursor.  Sorted, so dictionary code ==
+                # sorted position; the kernel maps value→code against a
+                # small runtime array — no per-batch host encode.
+                from pixie_tpu.table.table import Table as _Table
+
                 qd = Dictionary()
-                _prescan_unique(src, prov.name, qd, sort=True)
+                u = None
+                if isinstance(head, MemorySourceOp) and head.tablet is None:
+                    t = self.store.table(head.table)
+                    if type(t) is _Table and prov.name in t.relation:
+                        u = _int_key_uniques(t, prov.name, src)
+                if u is not None:
+                    qd.encode(u.tolist())
+                else:
+                    _prescan_unique(src, prov.name, qd, sort=True)
                 vals = np.asarray(qd.values(), dtype=np.int64)
                 lut_name = kern.ctx.ec._add_lut(vals)
                 keys.append(
@@ -1414,25 +1498,46 @@ class PlanExecutor:
         sig = None
         fb_sig = None
         if isinstance(head, MemorySourceOp):
+            # The fallback DECISION memo is data-independent (no rows_written/
+            # times): once keys prove non-dense, falling back stays correct as
+            # the table grows — and streaming polls must hit this memo FIRST,
+            # before any keyset work, so doomed aggs skip the union scan.
+            fb_sig = self._chain_cache_sig(
+                head, chain, dtypes, dicts, ["agg_fallback", _op_sig(op)]
+            )
+            if _cache_get(fb_sig) == "group_key_fallback":
+                raise GroupKeyFallback(f"agg {op.id}: cached fallback decision")
             extra = ["agg", _op_sig(op), ("mesh", self.mesh.size if self.mesh else 0)]
-            windowish = _windowish_groups(chain, self.store.table(head.table).time_col)
+            table = self.store.table(head.table)
+            windowish = _windowish_groups(chain, table.time_col)
             # Only intdevice keys bake data (their unique-value sets); window
             # origins are runtime parameters (_refresh_window_keys), so
             # windowed/dict-keyed aggs reuse one kernel across polls/ranges.
-            data_dependent = any(
-                g not in dicts and g not in windowish for g in op.groups
-            )
+            # Direct-source int keys sign by VALUE-SET CONTENT (incremental
+            # union, O(new rows)): a streaming poll without new key values
+            # reuses the kernel instead of rebuilding per rows_written.
+            # Tabletized tables (TabletsGroup) have no uid/row-id surface
+            # for the union cache — they take the rows_written signature.
+            from pixie_tpu.table.table import Table as _Table
+
+            data_dependent = False
+            plain_table = type(table) is _Table and head.tablet is None
+            for g in op.groups:
+                if g in dicts or g in windowish:
+                    continue
+                src_col = _group_source_column(chain, g)
+                u = None
+                if plain_table and src_col is not None \
+                        and src_col in table.relation and src_col not in dicts:
+                    u = _int_key_uniques(table, src_col, src)
+                if u is not None:
+                    extra.append(("keyset", g, len(u), hash(u.tobytes())))
+                else:
+                    data_dependent = True
             if data_dependent:
-                extra.append(self.store.table(head.table).stats()["rows_written"])
+                extra.append(table.stats()["rows_written"])
             sig = self._chain_cache_sig(
                 head, chain, dtypes, dicts, extra, include_times=data_dependent
-            )
-            # The fallback DECISION memo is data-independent (no rows_written/
-            # times): once keys prove non-dense, falling back stays correct as
-            # the table grows — and streaming polls must hit this memo, not
-            # rebuild a doomed kernel per poll.
-            fb_sig = self._chain_cache_sig(
-                head, chain, dtypes, dicts, ["agg_fallback", _op_sig(op)]
             )
         else:
             # Blocking-op-headed agg (e.g. the post-join re-aggregation):

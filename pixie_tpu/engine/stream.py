@@ -196,8 +196,16 @@ class StreamQuery:
         )
 
     # ------------------------------------------------------------------- drive
+    #: per-poll delta cap.  An unbounded delta (a poller that fell behind a
+    #: fast writer) would cross the engine's CPU/TPU crossover and pay
+    #: fixed-cost device round-trips + a bulk host→device upload of hot
+    #: data, compounding the lag; bounded deltas stay on the fast path and
+    #: the caller just polls again (see lagging()).
+    MAX_POLL_ROWS = 1 << 22
+
     def poll(self) -> dict[str, QueryResult]:
-        """Process rows appended since the last poll; return new emissions."""
+        """Process rows appended since the last poll (up to MAX_POLL_ROWS per
+        pipeline); return new emissions."""
         if self.closed:
             return {}
         out: dict[str, QueryResult] = {}
@@ -207,9 +215,24 @@ class StreamQuery:
                 out[pl.sink_name] = got
         return out
 
+    def lagging(self) -> bool:
+        """True if any pipeline has unprocessed rows (poll again, don't wait)."""
+        for pl in self.pipelines:
+            if pl.done:
+                continue
+            if self.store.table(pl.source.table).last_row_id() > pl.token:
+                return True
+        return False
+
     def close(self) -> dict[str, QueryResult]:
-        """End of stream: flush open windows / non-windowed agg state."""
+        """End of stream: drain everything unprocessed, then flush open
+        windows / non-windowed agg state."""
         out = self.poll()
+        while self.lagging():
+            got = self.poll()
+            for name, res in got.items():
+                out[name] = (_concat_results(out[name], res)
+                             if name in out else res)
         self.closed = True
         for pl in self.pipelines:
             if pl.agg is None or pl.acc is None:
@@ -229,7 +252,7 @@ class StreamQuery:
         if pl.done:
             return None
         table = self.store.table(pl.source.table)
-        hi = table.last_row_id()
+        hi = min(table.last_row_id(), pl.token + self.MAX_POLL_ROWS)
         if hi <= pl.token:
             return None
         pl.source.since_row_id = pl.token
@@ -309,7 +332,7 @@ class StreamQuery:
             if pl.agg is None:
                 continue  # chain pipelines stream rows via poll()
             table = self.store.table(pl.source.table)
-            hi = table.last_row_id()
+            hi = min(table.last_row_id(), pl.token + self.MAX_POLL_ROWS)
             if hi <= pl.token:
                 continue
             pl.source.since_row_id = pl.token

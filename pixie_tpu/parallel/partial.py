@@ -203,8 +203,9 @@ def finalize_partial(
             )
     for ae in agg.values:
         uda = registry.uda(ae.fn)
-        # Re-init instance state for finalize (QuantileUDA binds its sketch in init).
-        uda.init(max(g, 1), np.float64)
+        # finalize_host is host-pure by contract (no instance state from
+        # init) — calling uda.init here would dispatch a device op with a
+        # poll-varying group-count shape, i.e. a fresh XLA compile per poll.
         col = uda.finalize_host(pb.states[ae.out_name])
         out_dt = uda.out_type(pb.in_types.get(ae.out_name))
         vals = np.asarray(col)

@@ -147,8 +147,20 @@ class ClusterStreamQuery:
         res = ex.run()[pl.sink_name]
         return res if res.num_rows else None
 
+    def lagging(self) -> bool:
+        """True while any agent has unprocessed rows (per-poll deltas are
+        capped at StreamQuery.MAX_POLL_ROWS)."""
+        return any(sq.lagging() for sq in self._agent_sqs.values())
+
     def close(self) -> dict[str, QueryResult]:
         out = self.poll()
+        # Drain everything left behind the per-poll cap before flushing —
+        # one poll is no longer guaranteed to reach last_row_id.
+        while self.lagging():
+            got = self.poll()
+            for name, res in got.items():
+                out[name] = (_concat_results(out[name], res)
+                             if name in out else res)
         self.closed = True
         for pl in self._ref.pipelines:
             if pl.agg is None:

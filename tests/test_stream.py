@@ -245,3 +245,23 @@ px.display(df, 'out')
     assert list(got["time_"]) == [0]
     assert list(got["cnt"]) == [3]
     assert sq.close() == {}  # open window [2s,3s) has cnt=1, filtered
+
+
+def test_close_drains_past_poll_cap(monkeypatch):
+    """close() must process EVERYTHING unprocessed, even when per-poll
+    deltas are capped (regression: a capped close silently dropped rows)."""
+    from pixie_tpu.engine.stream import StreamQuery
+
+    monkeypatch.setattr(StreamQuery, "MAX_POLL_ROWS", 64)
+    ts = _store(batch_rows=64)
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='http_events').stream()
+df = df.groupby('service').agg(cnt=('latency', px.count))
+px.display(df, 'out')
+""",
+        ts,
+    )
+    _write(ts, 0, 1000, svc="x", lat=1.0)  # 1000 rows >> 64-row cap
+    fin = sq.close()["out"].to_pandas()
+    assert int(fin["cnt"].sum()) == 1000

@@ -105,3 +105,30 @@ def test_tablet_plan_roundtrip():
     r1 = execute_plan(p, ts)["out"].to_pandas().sort_values("svc").reset_index(drop=True)
     r2 = execute_plan(p2, ts)["out"].to_pandas().sort_values("svc").reset_index(drop=True)
     assert (r1 == r2).all().all()
+
+
+def test_int_group_key_on_tabletized_table():
+    """Regression: intdevice group keys on a TabletsGroup must not crash on
+    the unique-set cache (TabletsGroup has no row-id surface)."""
+    rng = np.random.default_rng(4)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("pod", DT.STRING), ("code", DT.INT64),
+    )
+    t = ts.create("codes", rel, tablet_col="pod", batch_rows=512)
+    n = 4000
+    data = {
+        "time_": np.arange(n, dtype=np.int64),
+        "pod": np.array(["p0", "p1"])[rng.integers(0, 2, n)],
+        "code": rng.choice([200, 404, 500], n),
+    }
+    t.write(data)
+    p = Plan()
+    src = p.add(MemorySourceOp(table="codes"))
+    agg = p.add(AggOp(groups=["code"], values=[AggExpr("n", "count", None)]),
+                parents=[src])
+    p.add(MemorySinkOp(name="out"), parents=[agg])
+    res = execute_plan(p, ts)["out"].to_pandas().sort_values("code")
+    want = pd.Series(data["code"]).value_counts().sort_index()
+    assert list(res["code"]) == list(want.index)
+    assert list(res["n"]) == list(want.values)
