@@ -38,14 +38,23 @@ def _fmt_bytes(b: float) -> str:
     return f"{b:.0f}B"
 
 
-def _formatter(col: str):
-    lc = col.lower()
-    if lc in ("latency", "latency_ns") or lc.endswith("_time_ns") or lc.endswith("duration_ns") or lc.startswith("latency_p"):
+def _formatter(cs):
+    """ColumnSchema → value formatter, driven by the SEMANTIC type the
+    engine propagates through query results (reference: vis formatting by
+    ST, vispb/vis.proto) — no column-name guessing."""
+    from pixie_tpu.types import SemanticType as ST
+
+    st = cs.semantic_type
+    if st == ST.ST_DURATION_NS:
         return _fmt_duration
-    if lc.endswith("_bytes") or lc.startswith("bytes_"):
+    if st == ST.ST_BYTES:
         return _fmt_bytes
-    if lc.endswith("_rate") or lc.endswith("_percent"):
+    if st == ST.ST_PERCENT:
         return lambda v: f"{float(v) * 100:.2f}%"
+    if st == ST.ST_THROUGHPUT_BYTES_PER_NS:
+        return lambda v: _fmt_bytes(float(v) * 1e9) + "/s"
+    if st == ST.ST_THROUGHPUT_PER_NS:
+        return lambda v: f"{float(v) * 1e9:.2f}/s"
     return None
 
 
@@ -58,7 +67,7 @@ def render_table(result, max_rows: int = 40) -> str:
         arr = result.columns[n][:shown_n]
         d = result.dictionaries.get(n)
         vals = d.decode(arr) if d is not None else arr.tolist()
-        fmt = _formatter(n)
+        fmt = _formatter(result.relation.col(n))
         if fmt is not None:
             try:
                 vals = [fmt(v) if v is not None else "" for v in vals]

@@ -2129,7 +2129,10 @@ class PlanExecutor:
                 )
                 for n in out_names
             }
-            rel = Relation([ColumnSchema(n, out_dtypes[n]) for n in out_names])
+            from pixie_tpu.engine.semantics import sink_relation
+
+            rel = sink_relation(self.plan, sink, out_names, out_dtypes,
+                                self.store, self.registry)
             nrows = len(next(iter(cols.values()))) if cols else 0
             self.stats["rows_output"] += nrows
             results[sink.name] = QueryResult(

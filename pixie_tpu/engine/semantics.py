@@ -1,0 +1,189 @@
+"""Semantic-type propagation through plans.
+
+Reference: semantic types (src/shared/types/typespb/types.proto:63-91) ride
+column schemas end-to-end and drive client/vis formatting (duration columns
+render as '2.3ms', bytes as '1.2MB', pod names link to entities).  The
+reference resolves STs during compilation (SemanticRuleBatch); here the
+analysis is a PLAN walk at execution time — the plan plus the source
+schemas fully determine output STs, so kernels never carry them.
+
+Rules:
+  * sources: the table/UDTF/remote-channel relation's declared STs
+  * Map: Column refs inherit; Calls take the UDF's declared `out_st`, or the
+    first ST-typed argument's ST when `st_preserve` (bin over time is time)
+  * Filter/Limit: pass-through
+  * Agg: group keys inherit; values take the UDA's `out_st` or the input's
+    ST when `st_preserve` (p50 of durations is a duration)
+  * Join: each output takes its side's ST; Union: first parent's
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from pixie_tpu.plan.plan import (
+    AggOp,
+    Call,
+    Column,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySourceOp,
+    RemoteSourceOp,
+    UDTFSourceOp,
+    UnionOp,
+)
+from pixie_tpu.types import Relation, SemanticType as ST
+
+_NONE = ST.ST_NONE
+
+
+def _call_st(expr: Call, env: dict, registry) -> ST:
+    udf = None
+    try:
+        overloads = registry._scalar.get(expr.fn) or []
+        udf = overloads[0] if overloads else None
+    except AttributeError:  # registry without scalar table
+        udf = None
+    if udf is not None and udf.out_st is not None:
+        return udf.out_st
+    if udf is not None and udf.st_preserve:
+        for a in expr.args:
+            st = _expr_st(a, env, registry)
+            if st != _NONE:
+                return st
+    return _NONE
+
+
+def _expr_st(expr, env: dict, registry) -> ST:
+    if isinstance(expr, Column):
+        return env.get(expr.name, _NONE)
+    if isinstance(expr, Call):
+        return _call_st(expr, env, registry)
+    return _NONE
+
+
+def semantic_types(plan, op, store, registry, memo: Optional[dict] = None
+                   ) -> dict:
+    """{column: SemanticType} of `op`'s output."""
+    if memo is None:
+        memo = {}
+    got = memo.get(op.id)
+    if got is not None:
+        return got
+    out: dict = {}
+    if isinstance(op, MemorySourceOp):
+        try:
+            rel = store.table(op.table).relation
+        except Exception:
+            rel = None
+        if rel is not None:
+            cols = op.columns or rel.names()
+            out = {c.name: c.semantic_type for c in rel if c.name in cols}
+    elif isinstance(op, (UDTFSourceOp, RemoteSourceOp)):
+        if op.schema is not None:
+            rel = Relation.from_dict(op.schema)
+            out = {c.name: c.semantic_type for c in rel}
+        elif isinstance(op, UDTFSourceOp):
+            try:
+                rel = registry.udtf(op.name).relation
+                out = {c.name: c.semantic_type for c in rel}
+            except Exception:
+                out = {}
+    elif isinstance(op, MapOp):
+        env = semantic_types(plan, plan.parents(op)[0], store, registry, memo)
+        out = {name: _expr_st(e, env, registry) for name, e in op.exprs}
+    elif isinstance(op, (FilterOp, LimitOp)):
+        out = dict(semantic_types(plan, plan.parents(op)[0], store, registry,
+                                  memo))
+    elif isinstance(op, AggOp):
+        env = semantic_types(plan, plan.parents(op)[0], store, registry, memo)
+        out = {g: env.get(g, _NONE) for g in op.groups}
+        for ae in op.values:
+            st = _NONE
+            try:
+                uda = registry.uda(ae.fn)
+            except Exception:
+                uda = None
+            if uda is not None:
+                if uda.out_st is not None:
+                    st = uda.out_st
+                    # quantiles of durations are duration-quantiles
+                    # (typespb ST_DURATION_NS_QUANTILES exists for this)
+                    if st == ST.ST_QUANTILES and ae.arg is not None \
+                            and env.get(ae.arg) == ST.ST_DURATION_NS:
+                        st = ST.ST_DURATION_NS_QUANTILES
+                elif uda.st_preserve and ae.arg is not None:
+                    st = env.get(ae.arg, _NONE)
+            out[ae.out_name] = st
+    elif isinstance(op, JoinOp):
+        left, right = plan.parents(op)
+        lenv = semantic_types(plan, left, store, registry, memo)
+        renv = semantic_types(plan, right, store, registry, memo)
+        if op.output:
+            for side, col, out_name in op.output:
+                env = lenv if side == "left" else renv
+                out[out_name] = env.get(col, _NONE)
+        else:
+            out = {**renv, **lenv}
+    elif isinstance(op, UnionOp):
+        out = dict(semantic_types(plan, plan.parents(op)[0], store, registry,
+                                  memo))
+    else:  # unknown op kinds contribute nothing rather than failing queries
+        parents = plan.parents(op)
+        if parents:
+            out = dict(semantic_types(plan, parents[0], store, registry, memo))
+    memo[op.id] = out
+    return out
+
+
+class SchemaStore:
+    """Store shim exposing .table(name).relation from a schema dict — lets
+    the broker (which holds agent-reported schemas, not tables) run the same
+    plan-level ST propagation as a local executor."""
+
+    class _T:
+        def __init__(self, relation):
+            self.relation = relation
+
+    def __init__(self, schemas: dict):
+        self._schemas = schemas
+
+    def table(self, name: str):
+        return self._T(self._schemas[name])
+
+
+def restamp_result(result, plan, store, registry):
+    """Overwrite a QueryResult's relation STs from the LOGICAL plan.
+
+    Distributed/streaming executions run merger/post plans whose sources are
+    remote channels with no ST knowledge; the logical plan + source schemas
+    still fully determine the output STs."""
+    from pixie_tpu.types import ColumnSchema
+
+    for sink in plan.sinks():
+        if getattr(sink, "name", None) != result.name:
+            continue
+        parents = plan.parents(sink)
+        if not parents:
+            break
+        sts = semantic_types(plan, parents[0], store, registry)
+        result.relation = Relation([
+            ColumnSchema(c.name, c.data_type,
+                         sts.get(c.name, c.semantic_type))
+            for c in result.relation
+        ])
+        break
+    return result
+
+
+def sink_relation(plan, sink, out_names, out_dtypes, store, registry
+                  ) -> Relation:
+    """Typed output relation for a sink: physical dtypes + propagated STs."""
+    from pixie_tpu.types import ColumnSchema
+
+    parent = plan.parents(sink)[0]
+    sts = semantic_types(plan, parent, store, registry)
+    return Relation([
+        ColumnSchema(n, out_dtypes[n], sts.get(n, _NONE)) for n in out_names
+    ])

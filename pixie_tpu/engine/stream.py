@@ -115,6 +115,11 @@ class StreamQuery:
         self.registry = registry or default_registry
         self.lateness_ns = int(lateness_ns)
         self.closed = False
+        #: the logical plan — kept for semantic-type restamping of emissions
+        #: (post plans read a channel source with no ST knowledge)
+        self.plan = plan
+        #: sink name → ST-stamped relation, computed once (constant per sink)
+        self._st_rel_cache: dict[str, object] = {}
         self.pipelines: list[_Pipeline] = []
         for sink in plan.sinks():
             if not isinstance(sink, MemorySinkOp):
@@ -346,11 +351,21 @@ class StreamQuery:
         return finalize_partial(pl.agg, pb, self.registry)
 
     def _run_post(self, pl: _Pipeline, hb: HostBatch) -> Optional[QueryResult]:
+        from pixie_tpu.engine.semantics import restamp_result
+
         ex = PlanExecutor(
             pl.post, self.store, self.registry, inputs={self.CHANNEL: hb}
         )
         res = ex.run()[pl.sink_name]
-        return res if res.num_rows else None
+        if res.num_rows:
+            rel = self._st_rel_cache.get(pl.sink_name)
+            if rel is not None and rel.names() == res.relation.names():
+                res.relation = rel  # constant per sink; skip the plan walk
+            else:
+                restamp_result(res, self.plan, self.store, self.registry)
+                self._st_rel_cache[pl.sink_name] = res.relation
+            return res
+        return None
 
 
 def split_closing_windows(acc, window_key: str, close_below: int,

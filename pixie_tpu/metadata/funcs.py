@@ -9,13 +9,39 @@ execution time (state is epoch-swapped; a query sees one epoch).
 from __future__ import annotations
 
 from pixie_tpu.metadata import state as mdstate
-from pixie_tpu.types import DataType as DT
+from pixie_tpu.types import DataType as DT, SemanticType as ST
 from pixie_tpu.types import UInt128
 from pixie_tpu.udf.udf import Registry, ScalarUDF
 
 _S = DT.STRING
 _U = DT.UINT128
 _I = DT.INT64
+
+#: declared output semantic types (reference metadata_ops.h declares these on
+#: each UDF's ExecOutputType) — drives entity-aware result formatting
+_OUT_STS = {
+    "upid_to_pod_name": ST.ST_POD_NAME,
+    "pod_id_to_pod_name": ST.ST_POD_NAME,
+    "upid_to_namespace": ST.ST_NAMESPACE_NAME,
+    "pod_id_to_namespace": ST.ST_NAMESPACE_NAME,
+    "pod_name_to_namespace": ST.ST_NAMESPACE_NAME,
+    "upid_to_node_name": ST.ST_NODE_NAME,
+    "pod_id_to_node_name": ST.ST_NODE_NAME,
+    "upid_to_hostname": ST.ST_NODE_NAME,
+    "upid_to_service_name": ST.ST_SERVICE_NAME,
+    "pod_id_to_service_name": ST.ST_SERVICE_NAME,
+    "pod_name_to_service_name": ST.ST_SERVICE_NAME,
+    "service_id_to_service_name": ST.ST_SERVICE_NAME,
+    "ip_to_svc_name": ST.ST_SERVICE_NAME,
+    "ip_to_service_name": ST.ST_SERVICE_NAME,
+    "upid_to_container_name": ST.ST_CONTAINER_NAME,
+    "container_id_to_status": ST.ST_CONTAINER_STATUS,
+    "upid_to_pod_status": ST.ST_POD_STATUS,
+    "pod_name_to_pod_status": ST.ST_POD_STATUS,
+    "pod_name_to_status": ST.ST_POD_STATUS,
+    "pod_name_to_pod_ip": ST.ST_IP_ADDRESS,
+    "pod_name_to_start_time": ST.ST_TIME_NS,
+}
 
 
 def _pod(upid: UInt128):
@@ -33,7 +59,7 @@ def _host(name, args, out, fn, volatile=True):
     # extractors, string splitters) pass volatile=False so epoch churn does
     # not force needless re-jits.
     return ScalarUDF(name=name, arg_types=args, out_type=out, fn=fn, device=False,
-                     volatile=volatile)
+                     volatile=volatile, out_st=_OUT_STS.get(name))
 
 
 def register_metadata_funcs(r: Registry) -> None:

@@ -201,6 +201,12 @@ class LocalCluster:
         results = ex.run()
         # Per-agent exec stats ride along with every result (reference:
         # AgentExecutionStats shipped with the final chunk, carnot.cc:227-275).
+        # The merger plan's sources are channels (no ST knowledge); restamp
+        # semantic types from the LOGICAL plan + agent schemas.
+        from pixie_tpu.engine.semantics import SchemaStore, restamp_result
+
+        sstore = SchemaStore(self.schemas())
         for r in results.values():
+            restamp_result(r, logical, sstore, reg)
             r.exec_stats["agents"] = agent_stats
         return results

@@ -75,6 +75,8 @@ class ClusterStreamQuery:
         if any(pl.agg is None and pl.limit_ids for pl in ref.pipelines):
             raise Unimplemented("limits in distributed streaming chains")
         self.closed = False
+        #: sink name → ST-stamped relation, computed once (constant per sink)
+        self._st_rel_cache: dict[str, object] = {}
 
     # ---------------------------------------------------------------- polling
     def poll(self) -> dict[str, QueryResult]:
@@ -137,6 +139,7 @@ class ClusterStreamQuery:
         return self._emit(pl, emit)
 
     def _emit(self, pl, pb) -> Optional[QueryResult]:
+        from pixie_tpu.engine.semantics import restamp_result
         from pixie_tpu.parallel.partial import finalize_partial
 
         hb = finalize_partial(pl.agg, pb, self._ref.registry)
@@ -145,7 +148,16 @@ class ClusterStreamQuery:
             inputs={StreamQuery.CHANNEL: hb},
         )
         res = ex.run()[pl.sink_name]
-        return res if res.num_rows else None
+        if res.num_rows:
+            rel = self._st_rel_cache.get(pl.sink_name)
+            if rel is not None and rel.names() == res.relation.names():
+                res.relation = rel
+            else:
+                restamp_result(res, self._ref.plan, self._ref.store,
+                               self._ref.registry)
+                self._st_rel_cache[pl.sink_name] = res.relation
+            return res
+        return None
 
     def lagging(self) -> bool:
         """True while any agent has unprocessed rows (per-poll deltas are

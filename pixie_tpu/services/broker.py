@@ -322,7 +322,10 @@ class Broker:
                     cols=qr.columns,
                 )
                 client.send(wire.encode_host_batch(
-                    hb, {"msg": "result_chunk", "req_id": req_id, "table": name}
+                    hb, {"msg": "result_chunk", "req_id": req_id,
+                         "table": name,
+                         # semantic types ride the wire with the relation
+                         "relation": qr.relation.to_dict()}
                 ))
             client.send(wire.encode_json(
                 {"msg": "done", "req_id": req_id, "stats": _jsonable(stats)}
@@ -463,6 +466,13 @@ class Broker:
                 ),
             )
             results = ex.run()
+            # The merger plan's sources are channels (no STs); the LOGICAL
+            # plan + agent schemas determine them.
+            from pixie_tpu.engine.semantics import SchemaStore, restamp_result
+
+            sstore = SchemaStore(self.registry.combined_schemas())
+            for r in results.values():
+                restamp_result(r, q.plan, sstore, reg)
             stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
             for r in results.values():
                 r.exec_stats["agents"] = ctx.agent_stats

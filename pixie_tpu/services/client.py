@@ -102,7 +102,10 @@ class Client:
                 raise QueryError(p.error)
             out: dict[str, QueryResult] = {}
             for table, hb in p.chunks:
-                rel = Relation([ColumnSchema(n, hb.dtypes[n]) for n in hb.cols])
+                meta_rel = getattr(hb, "wire_meta", {}).get("relation")
+                rel = (Relation.from_dict(meta_rel) if meta_rel else
+                       Relation([ColumnSchema(n, hb.dtypes[n])
+                                 for n in hb.cols]))
                 out[table] = QueryResult(
                     name=table, relation=rel, columns=hb.cols,
                     dictionaries=hb.dicts, exec_stats=dict(p.stats),

@@ -9,6 +9,8 @@ pixie_tpu.metadata when a metadata state is attached.
 """
 from __future__ import annotations
 
+import dataclasses
+
 import re
 
 import jax.numpy as jnp
@@ -70,8 +72,12 @@ def register_all(r: Registry) -> None:
     r.register(_dev("floor", (_F,), _F, lambda a: jnp.floor(a)))
     r.register(_dev("round", (_F,), _F, lambda a: jnp.round(a)))
     # time binning: px.bin(t, size) — truncate to window start
-    r.register(_dev("bin", (_T, _I), _T, lambda t, s: t - t % jnp.where(s == 0, 1, s)))
-    r.register(_dev("bin", (_I, _I), _I, lambda t, s: t - t % jnp.where(s == 0, 1, s)))
+    r.register(dataclasses.replace(
+        _dev("bin", (_T, _I), _T, lambda t, s: t - t % jnp.where(s == 0, 1, s)),
+        st_preserve=True))
+    r.register(dataclasses.replace(
+        _dev("bin", (_I, _I), _I, lambda t, s: t - t % jnp.where(s == 0, 1, s)),
+        st_preserve=True))
 
     # ------------------------------------------------------------ comparisons
     for args in ((_I, _I), (_F, _F), (_B, _B), (_T, _T)):

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pixie_tpu.status import NotFound
-from pixie_tpu.types import DataType
+from pixie_tpu.types import DataType, SemanticType
 
 # ---------------------------------------------------------------------- scalar
 
@@ -52,6 +52,13 @@ class ScalarUDF:
     #: snapshot): their baked LUTs go stale when the state epoch advances, so
     #: kernel caches must key on the epoch (see executor._chain_cache_sig).
     volatile: bool = False
+    #: declared SEMANTIC type of the output (reference typespb ST_*), or None
+    #: — consumed by engine.semantics to type query results for formatting
+    out_st: "object" = None
+    #: True if the output keeps the semantic type of its first ST-typed
+    #: argument (bin over a time column stays a time, round over bytes stays
+    #: bytes)
+    st_preserve: bool = False
 
     def key(self) -> tuple:
         return (self.name, self.arg_types)
@@ -80,6 +87,11 @@ class UDA:
     #: Only order-insensitive pickers qualify (any) — min/max over codes
     #: would not be lexical order.
     dict_ok: bool = False
+    #: True if the aggregate's output keeps the input column's semantic type
+    #: (min/mean/p50 of durations are durations; count of anything is not)
+    st_preserve: bool = False
+    #: fixed output semantic type (e.g. quantiles → ST_QUANTILES), or None
+    out_st = None
 
     def out_type(self, in_type: DataType | None) -> DataType:
         raise NotImplementedError
@@ -137,6 +149,7 @@ class CountUDA(UDA):
 
 class SumUDA(UDA):
     name = "sum"
+    st_preserve = True
 
     def out_type(self, in_type):
         return DataType.FLOAT64 if in_type == DataType.FLOAT64 else DataType.INT64
@@ -158,6 +171,7 @@ class SumUDA(UDA):
 
 class MeanUDA(UDA):
     name = "mean"
+    st_preserve = True
 
     def out_type(self, in_type):
         return DataType.FLOAT64
@@ -187,6 +201,7 @@ class MeanUDA(UDA):
 
 class MinUDA(UDA):
     name = "min"
+    st_preserve = True
 
     def out_type(self, in_type):
         return in_type
@@ -210,6 +225,7 @@ class MinUDA(UDA):
 
 class MaxUDA(UDA):
     name = "max"
+    st_preserve = True
 
     def out_type(self, in_type):
         return in_type
@@ -285,6 +301,7 @@ class AnyUDA(UDA):
     'first-seen', is order-independent across shards/batches."""
 
     name = "any"
+    st_preserve = True
     dict_ok = True
 
     def out_type(self, in_type):
@@ -310,6 +327,8 @@ class AnyUDA(UDA):
 class QuantileUDA(UDA):
     """Single quantile via mergeable log-histogram sketch (replaces t-digest,
     reference src/carnot/funcs/builtins/math_sketches.h:34-49)."""
+
+    st_preserve = True
 
     def __init__(self, q: float, name: str | None = None):
         self.q = float(q)
@@ -340,6 +359,7 @@ class QuantilesUDA(UDA):
     """px.quantiles equivalent: ST_QUANTILES JSON column {p01,p10,p50,p90,p99}."""
 
     name = "quantiles"
+    out_st = SemanticType.ST_QUANTILES
     QS = (0.01, 0.10, 0.50, 0.90, 0.99)
 
     def out_type(self, in_type):

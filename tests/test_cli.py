@@ -32,9 +32,15 @@ def test_render_table_formats_semantics():
     from pixie_tpu.engine.result import QueryResult
     from pixie_tpu.types import ColumnSchema, DataType as DT, Relation
 
+    from pixie_tpu.types import SemanticType as ST
+
+    # Formatting is driven by SEMANTIC types on the relation (propagated by
+    # the engine), not by column-name heuristics.
     rel = Relation([
-        ColumnSchema("svc", DT.STRING), ColumnSchema("latency", DT.INT64),
-        ColumnSchema("total_bytes", DT.INT64), ColumnSchema("error_rate", DT.FLOAT64),
+        ColumnSchema("svc", DT.STRING),
+        ColumnSchema("latency", DT.INT64, ST.ST_DURATION_NS),
+        ColumnSchema("total_bytes", DT.INT64, ST.ST_BYTES),
+        ColumnSchema("error_rate", DT.FLOAT64, ST.ST_PERCENT),
     ])
     from pixie_tpu.table.dictionary import Dictionary
 
