@@ -1847,9 +1847,25 @@ class PlanExecutor:
     def run_agent(self) -> dict:
         """Execute an AGENT plan: returns {channel: payload} where payload is a
         HostBatch (rows channels) or PartialAggBatch (agg_state channels)."""
+        from pixie_tpu.plan.plan import PartitionSinkOp
+
         out = {}
         t0 = _time.perf_counter_ns()
         for sink in self.plan.sinks():
+            if isinstance(sink, PartitionSinkOp):
+                # hash-partitioned shuffle edge: one rows channel per bucket
+                from pixie_tpu.parallel.repartition import (
+                    partition_ids,
+                    split_host_batch,
+                )
+
+                parent = self.plan.parents(sink)[0]
+                hb = self._materialize_parent(parent)
+                part = partition_ids(hb, sink.keys, sink.n_parts)
+                for p, bucket in enumerate(
+                        split_host_batch(hb, part, sink.n_parts)):
+                    out[f"{sink.prefix}{p}"] = bucket
+                continue
             if not isinstance(sink, ResultSinkOp):
                 raise Internal(f"agent plan sink {sink.kind} is not a ResultSink")
             parent = self.plan.parents(sink)[0]

@@ -172,12 +172,26 @@ class LocalCluster:
                 payloads[cid].append(payload)
             agent_stats[agent_name] = stats
 
-        # 2. merge channel payloads (reference: Kelvin finalize / row merge).
-        inputs: dict[str, HostBatch] = {}
+        # 2. repartitioned joins: per-partition key-disjoint joins between
+        #    the agent stage and the merger (reference splitter shuffle).
         reg = self.registry
         if reg is None:
             from pixie_tpu.udf import registry as reg
+        from pixie_tpu.parallel.repartition import (
+            bucket_channels,
+            run_join_stages,
+            stage_output_inputs,
+        )
+
+        if dp.join_stages:
+            run_join_stages(dp, payloads, reg, store=self.merger_store)
+
+        # 3. merge channel payloads (reference: Kelvin finalize / row merge).
+        inputs: dict[str, HostBatch] = {}
+        consumed = bucket_channels(dp)
         for cid, ch in dp.channels.items():
+            if cid in consumed:
+                continue  # bucket channels were joined in their stage
             got = payloads.get(cid, [])
             if not got:
                 raise Internal(f"channel {cid} received no payloads")
@@ -185,6 +199,7 @@ class LocalCluster:
                 inputs[cid] = merge_partials(ch.agg, got, reg)
             else:
                 inputs[cid] = _union_host_batches(got)
+        inputs.update(stage_output_inputs(dp, payloads))
 
         # 3. run the merger plan over the injected channels.
         from pixie_tpu.udf.udtf import UDTFContext

@@ -65,6 +65,21 @@ class Channel:
 
 
 @dataclasses.dataclass
+class JoinStage:
+    """One repartitioned join: producers hash both sides into per-partition
+    bucket channels; each partition's buckets union and join independently
+    (key-disjoint), and the outputs concatenate into `out_channel`."""
+
+    fragment: Plan
+    left_prefix: str
+    right_prefix: str
+    left_channel: str
+    right_channel: str
+    out_channel: str
+    n_parts: int
+
+
+@dataclasses.dataclass
 class DistributedPlan:
     """Per-agent plans + the merger plan + channel specs."""
 
@@ -72,6 +87,9 @@ class DistributedPlan:
     merger_plan: Plan
     channels: dict  # channel id -> Channel
     merger: str
+    #: repartitioned large-large joins executed between the agent stage and
+    #: the merger plan (parallel.repartition.run_join_stages)
+    join_stages: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         return {
@@ -86,6 +104,16 @@ class DistributedPlan:
                 }
                 for c in self.channels.values()
             },
+            "join_stages": [
+                {"fragment": s.fragment.to_dict(),
+                 "left_prefix": s.left_prefix,
+                 "right_prefix": s.right_prefix,
+                 "left_channel": s.left_channel,
+                 "right_channel": s.right_channel,
+                 "out": s.out_channel,
+                 "n_parts": s.n_parts}
+                for s in self.join_stages
+            ],
         }
 
 
@@ -212,6 +240,62 @@ class DistributedPlanner:
             merger_plan.add(rs)
             lowered[agg.id] = rs  # merged+finalized agg arrives as rows
 
+        join_stages: list[JoinStage] = []
+
+        def cut_repartition_join(op, parents) -> bool:
+            """Large-large equijoin: hash-exchange both UNAGGREGATED sides
+            into key-disjoint partitions instead of funneling full rows to
+            one merger join (reference splitter shuffle, splitter.h:114-155).
+            Returns False when the shape doesn't qualify (single producer,
+            keyless/cross join, limited side ⇒ small side)."""
+            from pixie_tpu.plan.plan import JoinOp, PartitionSinkOp
+
+            if not (isinstance(op, JoinOp) and len(parents) == 2
+                    and op.left_on and op.right_on
+                    and all(p.id in agent_side for p in parents)
+                    and all(min_limit[p.id] == _INF for p in parents)):
+                return False
+            prods_l = producers_for(parents[0])
+            prods_r = producers_for(parents[1])
+            n_parts = len({a.name for a in prods_l}
+                          | {a.name for a in prods_r})
+            if n_parts < 2:
+                return False
+            j = next(chan_ids)
+            lp, rp = f"rp{j}l_", f"rp{j}r_"
+            out_cid = f"rp{j}out"
+            for parent, prefix, keys, prods in (
+                    (parents[0], lp, op.left_on, prods_l),
+                    (parents[1], rp, op.right_on, prods_r)):
+                for a in prods:
+                    cp = clone_into(a.name, parent)
+                    agent_plans[a.name].add(
+                        PartitionSinkOp(prefix=prefix, keys=list(keys),
+                                        n_parts=n_parts),
+                        parents=[cp],
+                    )
+                for p_i in range(n_parts):
+                    channels[f"{prefix}{p_i}"] = Channel(
+                        f"{prefix}{p_i}", "rows", [a.name for a in prods]
+                    )
+            frag = Plan()
+            left = frag.add(RemoteSourceOp(channel="left"))
+            right = frag.add(RemoteSourceOp(channel="right"))
+            jop = copy.copy(op)
+            jop.id = -1
+            frag.add(jop, parents=[left, right])
+            frag.add(ResultSinkOp(channel=out_cid, payload="rows"),
+                     parents=[jop])
+            join_stages.append(JoinStage(
+                fragment=frag, left_prefix=lp, right_prefix=rp,
+                left_channel="left", right_channel="right",
+                out_channel=out_cid, n_parts=n_parts,
+            ))
+            rs = RemoteSourceOp(channel=out_cid)
+            merger_plan.add(rs)
+            lowered[op.id] = rs
+            return True
+
         for op in logical.topo_sorted():
             if op.id in agent_side:
                 continue
@@ -228,6 +312,8 @@ class DistributedPlanner:
                 and self._partial_safe(op)
             ):
                 cut_agg(op, parents[0])
+                continue
+            if cut_repartition_join(op, parents):
                 continue
             for p in parents:
                 if p.id in agent_side:
@@ -260,4 +346,5 @@ class DistributedPlanner:
             merger_plan=merger_plan,
             channels=channels,
             merger=merger.name,
+            join_stages=join_stages,
         )

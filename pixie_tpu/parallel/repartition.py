@@ -1,0 +1,217 @@
+"""Keyed repartition: hash-partitioned exchange for large-large joins.
+
+Reference: the splitter repartitions at arbitrary blocking boundaries via
+GRPCSink/GRPCSourceGroup shuffle edges (splitter/splitter.h:114-155); a join
+of two unaggregated sides hash-exchanges both inputs so each consumer joins
+one key-disjoint partition.  TPU-native shape here:
+
+  * host exchange: agents hash rows by key VALUE (stable across processes —
+    dictionary codes are per-agent) into P buckets; bucket p from every
+    producer lands with consumer p, which joins locally.  Each bucket is an
+    ordinary rows channel, so the wire format is unchanged.
+  * in-mesh exchange: `mesh_repartition` performs the same keyed exchange
+    across mesh devices with ONE lax.all_to_all inside shard_map — the ICI
+    analog of the host shuffle for SPMD fragments.
+"""
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from pixie_tpu.status import Internal
+
+#: splitmix64 constants — stable integer mixing, identical on every host
+_SM_GAMMA = np.uint64(0x9E3779B97F4A7C15)
+_SM_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_SM_M2 = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    z = (x + _SM_GAMMA).astype(np.uint64)
+    z = (z ^ (z >> np.uint64(30))) * _SM_M1
+    z = (z ^ (z >> np.uint64(27))) * _SM_M2
+    return z ^ (z >> np.uint64(31))
+
+
+def _column_hash(hb, name: str) -> np.ndarray:
+    """Per-row u64 hash of a column by VALUE (not by per-agent dict code)."""
+    col = np.asarray(hb.cols[name])
+    d = hb.dicts.get(name)
+    if d is None:
+        with np.errstate(over="ignore"):
+            return _splitmix64(col.astype(np.int64).view(np.uint64))
+    # Hash each UNIQUE value once (crc32 is process-stable, unlike hash()),
+    # then spread per-row through the code LUT.
+    uniq = [zlib.crc32(str(v).encode()) for v in d.values()]
+    lut = _splitmix64(np.asarray(uniq, dtype=np.uint64))
+    codes = col.astype(np.int64)
+    out = np.zeros(len(codes), dtype=np.uint64)
+    valid = codes >= 0
+    out[valid] = lut[codes[valid]]
+    out[~valid] = np.uint64(0x6E756C6C)  # nulls hash together ("null")
+    return out
+
+
+def partition_ids(hb, keys: list, n_parts: int) -> np.ndarray:
+    """Stable partition id per row from the key columns' VALUES."""
+    if not keys:
+        raise Internal("repartition requires at least one key")
+    with np.errstate(over="ignore"):
+        h = np.zeros(hb.num_rows, dtype=np.uint64)
+        for k in keys:
+            h = h * _SM_GAMMA + _column_hash(hb, k)
+        h = _splitmix64(h)
+    return (h % np.uint64(n_parts)).astype(np.int64)
+
+
+def split_host_batch(hb, part: np.ndarray, n_parts: int) -> list:
+    """HostBatch → one HostBatch per partition (dictionaries shared)."""
+    from pixie_tpu.engine.executor import HostBatch
+
+    order = np.argsort(part, kind="stable")
+    sorted_part = part[order]
+    bounds = np.searchsorted(sorted_part, np.arange(n_parts + 1))
+    out = []
+    for p in range(n_parts):
+        idx = order[bounds[p]:bounds[p + 1]]
+        out.append(HostBatch(
+            dict(hb.dtypes), dict(hb.dicts),
+            {c: np.asarray(v)[idx] for c, v in hb.cols.items()},
+        ))
+    return out
+
+
+# ------------------------------------------------------------ join stages
+def run_join_stages(dp, payloads: dict, registry, store=None,
+                    max_workers: int = 8) -> None:
+    """Execute a DistributedPlan's repartition-join stages.
+
+    For each stage: partition p's buckets from every producer (both sides)
+    union and join in parallel workers — each partition holds a key-disjoint
+    slice, so the per-partition joins concatenate into the exact join.
+    Consumes the bucket channels from `payloads` and adds the join-output
+    channel.  (In-process consumers; a networked deployment can place each
+    partition's join on a data agent — the channels are ordinary rows
+    channels either way.)
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pixie_tpu.engine.executor import PlanExecutor
+    from pixie_tpu.parallel.cluster import _union_host_batches
+    from pixie_tpu.table.table import TableStore
+
+    from pixie_tpu.engine.executor import HostBatch
+
+    for stage in getattr(dp, "join_stages", None) or []:
+        def run_part(p, stage=stage):
+            def gather(prefix):
+                got = payloads.get(f"{prefix}{p}", [])
+                if not got:
+                    raise Internal(
+                        f"repartition channel {prefix}{p} got no payloads")
+                # same wire-shape contract as ordinary rows channels: a
+                # mis-typed agent payload fails cleanly, not deep in a join
+                if not all(isinstance(b, HostBatch) for b in got):
+                    raise Internal(
+                        f"repartition channel {prefix}{p}: expected row "
+                        f"payloads")
+                return _union_host_batches(got)
+
+            ex = PlanExecutor(
+                stage.fragment, store or TableStore(), registry,
+                inputs={stage.left_channel: gather(stage.left_prefix),
+                        stage.right_channel: gather(stage.right_prefix)},
+            )
+            return ex.run_agent()[stage.out_channel]
+
+        with ThreadPoolExecutor(max_workers=min(stage.n_parts,
+                                                max_workers)) as pool:
+            parts = list(pool.map(run_part, range(stage.n_parts)))
+        payloads[stage.out_channel] = parts
+
+
+def bucket_channels(dp) -> set:
+    """Channel ids consumed by join stages (excluded from the merger's
+    channel-input merge) — shared by LocalCluster and the broker so the two
+    execution paths cannot drift."""
+    consumed = set()
+    for s in getattr(dp, "join_stages", None) or []:
+        for p in range(s.n_parts):
+            consumed.add(f"{s.left_prefix}{p}")
+            consumed.add(f"{s.right_prefix}{p}")
+    return consumed
+
+
+def stage_output_inputs(dp, payloads: dict) -> dict:
+    """{out_channel: unioned HostBatch} for every executed join stage."""
+    from pixie_tpu.parallel.cluster import _union_host_batches
+
+    return {
+        s.out_channel: _union_host_batches(payloads[s.out_channel])
+        for s in (getattr(dp, "join_stages", None) or [])
+    }
+
+
+# ------------------------------------------------------- in-mesh all_to_all
+def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
+    """Build a jittable keyed repartition over a mesh axis.
+
+    Returns fn(cols_sharded, n_valid_per_shard) -> (cols_exchanged, counts):
+    each device buckets its rows by `key_fn(cols) % n_devices`, pads buckets
+    to the shard size, and ONE lax.all_to_all delivers bucket d to device d —
+    the ICI shuffle edge (reference GRPCSink/Source exchange, but a single
+    collective).  Output rows per device are padded; `counts[d]` gives the
+    valid rows received from each peer.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh.shape[axis]
+
+    def local(cols, n_valid):
+        first = next(iter(cols.values()))
+        rows = first.shape[0]
+        part = key_fn(cols) % n_dev
+        ridx = jnp.arange(rows)
+        valid = ridx < n_valid
+        # stable bucket order: sort by (partition, row index)
+        order = jnp.argsort(jnp.where(valid, part, n_dev) * (rows + 1) + ridx)
+        sorted_part = jnp.where(valid, part, n_dev)[order]
+        # per-bucket counts + dense per-bucket layout [n_dev, rows]
+        counts = jnp.bincount(sorted_part, length=n_dev + 1)[:n_dev].astype(
+            jnp.int64)
+        starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                                  jnp.cumsum(counts)])[:n_dev]
+        within = ridx - jnp.take(starts, jnp.clip(sorted_part, 0, n_dev - 1))
+        # invalid rows scatter into a dump slot past the buckets — writing
+        # them into a clipped bucket would zero real data
+        dest = jnp.where(
+            sorted_part < n_dev,
+            jnp.clip(sorted_part, 0, n_dev - 1) * rows + within,
+            n_dev * rows,
+        )
+        buckets = {}
+        for name, col in cols.items():
+            flat = jnp.zeros((n_dev * rows + 1,), col.dtype)
+            src = jnp.take(col, order)
+            flat = flat.at[dest].set(src)
+            buckets[name] = flat[: n_dev * rows].reshape(n_dev, rows)
+        # ONE collective: bucket d goes to device d
+        exchanged = {
+            name: lax.all_to_all(b, axis, split_axis=0, concat_axis=0,
+                                 tiled=False)
+            for name, b in buckets.items()
+        }
+        recv_counts = lax.all_to_all(counts.reshape(n_dev, 1), axis, 0, 0,
+                                     tiled=False).reshape(n_dev)
+        return exchanged, recv_counts
+
+    shard = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=({k: P(axis) for k in n_cols}, P(axis)),
+        out_specs=({k: P(axis) for k in n_cols}, P(axis)),
+    )
+    return jax.jit(shard)

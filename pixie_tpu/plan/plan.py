@@ -295,6 +295,22 @@ class ResultSinkOp(Operator):
 
 
 @dataclasses.dataclass
+class PartitionSinkOp(Operator):
+    """Agent-plan sink hash-partitioning parent rows by key VALUE into
+    n_parts bucket channels `{prefix}{p}` (the shuffle-edge producer half of
+    a repartitioned join — reference splitter.h:114-155 GRPCSink shuffle).
+    Each bucket ships as an ordinary rows channel."""
+
+    prefix: str = ""
+    keys: list[str] = dataclasses.field(default_factory=list)
+    n_parts: int = 1
+
+    def _fields(self):
+        return {"prefix": self.prefix, "keys": list(self.keys),
+                "n_parts": self.n_parts}
+
+
+@dataclasses.dataclass
 class RemoteSourceOp(Operator):
     """Source on a merger plan reading a channel fed by remote agents
     (reference exec/grpc_source_node.* + grpc_router.h demux)."""
@@ -440,6 +456,9 @@ def _op_from_dict(d: dict):
         return OTelExportSinkOp(config=dict(d["config"]))
     if k == "resultsink":
         return ResultSinkOp(channel=d["channel"], payload=d["payload"])
+    if k == "partitionsink":
+        return PartitionSinkOp(prefix=d["prefix"], keys=list(d["keys"]),
+                               n_parts=int(d["n_parts"]))
     if k == "remotesource":
         return RemoteSourceOp(channel=d["channel"], schema=d["schema"])
     raise InvalidArgument(f"unknown operator kind {k!r}")

@@ -440,8 +440,23 @@ class Broker:
             reg = self.udf_registry
             if reg is None:
                 from pixie_tpu.udf import registry as reg
+            from pixie_tpu.parallel.repartition import (
+                bucket_channels,
+                run_join_stages,
+                stage_output_inputs,
+            )
+
+            if dp.join_stages:
+                # repartitioned joins run partition-parallel on the merger
+                # (the Kelvin role); bucket channels are consumed here, with
+                # the same payload-shape contract as rows channels
+                run_join_stages(dp, ctx.payloads, reg,
+                                store=self.merger_store)
+            consumed = bucket_channels(dp)
             inputs: dict[str, HostBatch] = {}
             for cid, ch in dp.channels.items():
+                if cid in consumed:
+                    continue
                 got = ctx.payloads.get(cid, [])
                 if not got:
                     raise Internal(f"channel {cid} received no payloads")
@@ -453,6 +468,7 @@ class Broker:
                     if not all(isinstance(p, HostBatch) for p in got):
                         raise Internal(f"channel {cid}: expected row payloads")
                     inputs[cid] = _union_host_batches(got)
+            inputs.update(stage_output_inputs(dp, ctx.payloads))
 
             from pixie_tpu.udf.udtf import UDTFContext
 
