@@ -121,6 +121,20 @@ def _demo_cluster():
     return store, now
 
 
+def _render_results(out_name, results, kinds, args) -> None:
+    for sink, res in results.items():
+        kind = kinds.get(out_name, "Table")
+        hdr = f"== {out_name}/{sink} [{kind}] ({res.num_rows} rows)"
+        print(hdr)
+        print(render_table(res, max_rows=args.max_rows))
+        if args.analyze and res.exec_stats.get("operators"):
+            from pixie_tpu.plan.debug import render_stats
+
+            print("-- exec stats:")
+            print(render_stats(res.exec_stats))
+        print()
+
+
 def cmd_run(args) -> int:
     source, vis, name = _load_script(args.script)
     overrides = {}
@@ -158,20 +172,52 @@ def cmd_run(args) -> int:
                 tp_mgr.apply(q.mutations)
             return execute_plan(q.plan, store, analyze=args.analyze)
 
-    kinds = vis.widget_kinds() if vis is not None else {}
-    for out_name, fn, fargs in runs:
-        results = execute(fn, fargs)
-        for sink, res in results.items():
-            kind = kinds.get(out_name, "Table")
-            hdr = f"== {out_name}/{sink} [{kind}] ({res.num_rows} rows)"
-            print(hdr)
-            print(render_table(res, max_rows=args.max_rows))
-            if args.analyze and res.exec_stats.get("operators"):
+        if len(runs) > 1:
+            # Multi-widget vis: fuse all funcs' plans so shared subplans
+            # (scans, filters, first aggregates) execute ONCE
+            # (reference MergeNodesRule, optimizer.h:39).
+            from pixie_tpu.plan.fusion import fuse_compiled
+
+            compiled = [
+                (out, compile_pxl(source, schemas, func=fn, func_args=fargs,
+                                  now=now))
+                for out, fn, fargs in runs
+            ]
+            fused, sink_map, muts = fuse_compiled(compiled)
+            if muts:
+                tp_mgr.apply(muts)
+            all_results = execute_plan(fused, store, analyze=args.analyze)
+
+            def execute_fused(out_name):
+                return {
+                    orig: all_results[fused_name]
+                    for orig, fused_name in sink_map.get(out_name, {}).items()
+                }
+
+            kinds = vis.widget_kinds()
+            render_args = args
+            if args.analyze:
+                # every fused result shares ONE executor's stats — print
+                # them once at the end, not per widget
+                import copy as _copy
+
+                render_args = _copy.copy(args)
+                render_args.analyze = False
+            for out_name, _fn, _fargs in runs:
+                _render_results(out_name, execute_fused(out_name),
+                                kinds, render_args)
+            if args.analyze and all_results:
                 from pixie_tpu.plan.debug import render_stats
 
-                print("-- exec stats:")
-                print(render_stats(res.exec_stats))
-            print()
+                first = next(iter(all_results.values()))
+                if first.exec_stats.get("operators"):
+                    print("-- exec stats (fused plan):")
+                    print(render_stats(first.exec_stats))
+            return 0
+
+    kinds = vis.widget_kinds() if vis is not None else {}
+    for out_name, fn, fargs in runs:
+        _render_results(out_name, execute(fn, fargs), kinds, args)
     return 0
 
 

@@ -184,7 +184,8 @@ class LocalCluster:
         )
 
         if dp.join_stages:
-            run_join_stages(dp, payloads, reg, store=self.merger_store)
+            run_join_stages(dp, payloads, reg, store=self.merger_store,
+                            analyze=analyze)
 
         # 3. merge channel payloads (reference: Kelvin finalize / row merge).
         inputs: dict[str, HostBatch] = {}

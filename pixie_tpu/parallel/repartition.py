@@ -84,7 +84,7 @@ def split_host_batch(hb, part: np.ndarray, n_parts: int) -> list:
 
 # ------------------------------------------------------------ join stages
 def run_join_stages(dp, payloads: dict, registry, store=None,
-                    max_workers: int = 8) -> None:
+                    max_workers: int = 8, analyze: bool = False) -> None:
     """Execute a DistributedPlan's repartition-join stages.
 
     For each stage: partition p's buckets from every producer (both sides)
@@ -97,11 +97,9 @@ def run_join_stages(dp, payloads: dict, registry, store=None,
     """
     from concurrent.futures import ThreadPoolExecutor
 
-    from pixie_tpu.engine.executor import PlanExecutor
+    from pixie_tpu.engine.executor import HostBatch, PlanExecutor
     from pixie_tpu.parallel.cluster import _union_host_batches
     from pixie_tpu.table.table import TableStore
-
-    from pixie_tpu.engine.executor import HostBatch
 
     for stage in getattr(dp, "join_stages", None) or []:
         def run_part(p, stage=stage):
@@ -122,6 +120,7 @@ def run_join_stages(dp, payloads: dict, registry, store=None,
                 stage.fragment, store or TableStore(), registry,
                 inputs={stage.left_channel: gather(stage.left_prefix),
                         stage.right_channel: gather(stage.right_prefix)},
+                analyze=analyze,
             )
             return ex.run_agent()[stage.out_channel]
 

@@ -1,0 +1,67 @@
+"""Common-subplan fusion: merge several compiled plans into one, deduping
+structurally identical operators.
+
+Reference: MergeNodesRule (src/carnot/planner/compiler/optimizer/
+optimizer.h:39) fuses shared subplans so multi-widget vis scripts execute
+each shared scan/filter/agg ONCE.  Here the fusion is hash-consing over the
+op DAG: an operator is shared when its serialized fields and its (already
+fused) parents are identical.  Everything downstream is automatic — a single
+PlanExecutor materializes each blocking op once (`_materialized`) and the
+feed cache dedupes scan bytes, so fusing the plans IS the optimization.
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+from pixie_tpu.plan.plan import MemorySinkOp, Plan
+
+
+def merge_plans(named: list) -> tuple[Plan, dict]:
+    """[(prefix, Plan)] → (fused plan, {prefix: {orig sink: fused sink}}).
+
+    Sinks are never deduped: each input plan keeps its own, renamed
+    `{prefix}/{name}` so multi-func outputs stay addressable.
+    """
+    fused = Plan()
+    canon: dict = {}
+    sink_map: dict = {}
+    for prefix, plan in named:
+        local: dict = {}
+        for op in plan.topo_sorted():
+            parents = [local[p.id] for p in plan.parents(op)]
+            if isinstance(op, MemorySinkOp):
+                c = copy.copy(op)
+                c.id = -1
+                c.name = f"{prefix}/{op.name}" if prefix else op.name
+                fused.add(c, parents=parents)
+                local[op.id] = c
+                sink_map.setdefault(prefix, {})[op.name] = c.name
+                continue
+            d = op.to_dict()
+            d.pop("id", None)
+            key = (json.dumps(d, sort_keys=True, default=str),
+                   tuple(p.id for p in parents))
+            got = canon.get(key)
+            if got is None:
+                c = copy.copy(op)
+                c.id = -1
+                fused.add(c, parents=parents)
+                canon[key] = c
+                got = c
+            local[op.id] = got
+    return fused, sink_map
+
+
+def fuse_compiled(queries: list):
+    """[(prefix, CompiledQuery)] → (fused plan, sink_map, mutations).
+
+    Compile each vis func separately (each sees its own func args), then
+    fuse — the shared prefixes (same table scan, same filters, often the
+    same first aggregate) collapse.
+    """
+    muts = []
+    for _prefix, q in queries:
+        muts.extend(q.mutations or [])
+    fused, sink_map = merge_plans([(p, q.plan) for p, q in queries])
+    return fused, sink_map, muts

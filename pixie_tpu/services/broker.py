@@ -451,7 +451,7 @@ class Broker:
                 # (the Kelvin role); bucket channels are consumed here, with
                 # the same payload-shape contract as rows channels
                 run_join_stages(dp, ctx.payloads, reg,
-                                store=self.merger_store)
+                                store=self.merger_store, analyze=analyze)
             consumed = bucket_channels(dp)
             inputs: dict[str, HostBatch] = {}
             for cid, ch in dp.channels.items():

@@ -1,0 +1,118 @@
+"""Common-subplan fusion (reference MergeNodesRule, optimizer/optimizer.h:39):
+multi-widget vis scripts share scans/filters/aggregates across funcs."""
+import numpy as np
+import pytest
+
+from pixie_tpu.collect.schemas import all_schemas
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.metadata.state import global_manager, set_global_manager
+from pixie_tpu.plan.fusion import fuse_compiled, merge_plans
+from pixie_tpu.testing import build_demo_store, demo_metadata
+
+SEC = 1_000_000_000
+NOW = 600 * SEC
+
+SRC = """
+import px
+
+
+def f1(start_time: str):
+    df = px.DataFrame(table='http_events', start_time=start_time)
+    df = df[df.resp_status != 404]
+    df = df.groupby('req_method').agg(
+        n=('latency', px.count), m=('latency', px.mean))
+    return df
+
+
+def f2(start_time: str):
+    df = px.DataFrame(table='http_events', start_time=start_time)
+    df = df[df.resp_status != 404]
+    df = df.groupby('req_method').agg(
+        n=('latency', px.count), m=('latency', px.mean))
+    df = df[df.n > 1]
+    return df
+"""
+
+
+@pytest.fixture(scope="module")
+def demo():
+    old = global_manager()
+    mgr, _, _ = demo_metadata()
+    set_global_manager(mgr)
+    store = build_demo_store(rows=3000, now_ns=NOW)
+    yield store
+    set_global_manager(old)
+
+
+def _compile_two(demo):
+    schemas = all_schemas()
+    q1 = compile_pxl(SRC, schemas, func="f1",
+                     func_args={"start_time": "-5m"}, now=NOW)
+    q2 = compile_pxl(SRC, schemas, func="f2",
+                     func_args={"start_time": "-5m"}, now=NOW)
+    return q1, q2
+
+
+def test_merge_dedupes_shared_prefix(demo):
+    q1, q2 = _compile_two(demo)
+    fused, sink_map, _muts = fuse_compiled([("w1", q1), ("w2", q2)])
+    n1 = len(list(q1.plan.ops()))
+    n2 = len(list(q2.plan.ops()))
+    nf = len(list(fused.ops()))
+    # shared scan + filter + agg collapse; only f2's extra filter and the
+    # two sinks stay distinct
+    assert nf < n1 + n2
+    assert nf == max(n1, n2) + 1  # +1 = the second sink
+    assert sink_map["w1"]["output"] == "w1/output"
+    assert sink_map["w2"]["output"] == "w2/output"
+
+
+def test_fused_execution_scans_once_and_matches(demo):
+    q1, q2 = _compile_two(demo)
+    # unfused oracle
+    r1 = execute_plan(q1.plan, demo)["output"]
+    r2 = execute_plan(q2.plan, demo)["output"]
+
+    fused, sink_map, _ = fuse_compiled([("w1", q1), ("w2", q2)])
+    out = execute_plan(fused, demo)
+    f1 = out[sink_map["w1"]["output"]]
+    f2 = out[sink_map["w2"]["output"]]
+
+    for got, want in ((f1, r1), (f2, r2)):
+        assert got.num_rows == want.num_rows
+        g = got.to_pandas().sort_values("req_method").reset_index(drop=True)
+        w = want.to_pandas().sort_values("req_method").reset_index(drop=True)
+        assert list(g["n"]) == list(w["n"])
+        np.testing.assert_allclose(g["m"], w["m"])
+
+    # the shared scan ran ONCE: fused rows_scanned equals ONE func's scan,
+    # not the sum (the 'done' criterion — exec-stats feed counts)
+    solo_scanned = r1.exec_stats["rows_scanned"]
+    assert f1.exec_stats["rows_scanned"] == solo_scanned
+    assert f1.exec_stats["rows_scanned"] < (
+        r1.exec_stats["rows_scanned"] + r2.exec_stats["rows_scanned"])
+
+
+def test_identical_funcs_fully_collapse(demo):
+    q1, _ = _compile_two(demo)
+    q1b = compile_pxl(SRC, all_schemas(), func="f1",
+                      func_args={"start_time": "-5m"}, now=NOW)
+    fused, sink_map, _ = fuse_compiled([("a", q1), ("b", q1b)])
+    # everything shared except the two named sinks
+    assert len(list(fused.ops())) == len(list(q1.plan.ops())) + 1
+    out = execute_plan(fused, demo)
+    assert out["a/output"].num_rows == out["b/output"].num_rows
+
+
+def test_disjoint_plans_do_not_merge(demo):
+    schemas = all_schemas()
+    qa = compile_pxl(
+        "import px\ndf = px.DataFrame(table='http_events', start_time='-5m')\n"
+        "px.display(df)", schemas, now=NOW)
+    qb = compile_pxl(
+        "import px\ndf = px.DataFrame(table='dns_events', start_time='-5m')\n"
+        "px.display(df)", schemas, now=NOW)
+    fused, _sm, _ = fuse_compiled([("a", qa), ("b", qb)])
+    assert len(list(fused.ops())) == \
+        len(list(qa.plan.ops())) + len(list(qb.plan.ops()))
