@@ -121,12 +121,19 @@ def _demo_cluster():
     return store, now
 
 
-def _render_results(out_name, results, kinds, args) -> None:
+def _render_results(out_name, results, args, displays=None) -> None:
+    from pixie_tpu.cli_widgets import render_widget
+
     for sink, res in results.items():
-        kind = kinds.get(out_name, "Table")
+        w = (displays or {}).get(out_name)
+        kind = w.kind if w else "Table"
         hdr = f"== {out_name}/{sink} [{kind}] ({res.num_rows} rows)"
         print(hdr)
-        print(render_table(res, max_rows=args.max_rows))
+        chart = render_widget(kind, w.display if w else {}, res)
+        if chart:
+            print(chart)
+        else:
+            print(render_table(res, max_rows=args.max_rows))
         if args.analyze and res.exec_stats.get("operators"):
             from pixie_tpu.plan.debug import render_stats
 
@@ -194,7 +201,7 @@ def cmd_run(args) -> int:
                     for orig, fused_name in sink_map.get(out_name, {}).items()
                 }
 
-            kinds = vis.widget_kinds()
+            displays = vis.widget_displays()
             render_args = args
             if args.analyze:
                 # every fused result shares ONE executor's stats — print
@@ -205,7 +212,7 @@ def cmd_run(args) -> int:
                 render_args.analyze = False
             for out_name, _fn, _fargs in runs:
                 _render_results(out_name, execute_fused(out_name),
-                                kinds, render_args)
+                                render_args, displays)
             if args.analyze and all_results:
                 from pixie_tpu.plan.debug import render_stats
 
@@ -215,9 +222,9 @@ def cmd_run(args) -> int:
                     print(render_stats(first.exec_stats))
             return 0
 
-    kinds = vis.widget_kinds() if vis is not None else {}
+    displays = vis.widget_displays() if vis is not None else {}
     for out_name, fn, fargs in runs:
-        _render_results(out_name, execute(fn, fargs), kinds, args)
+        _render_results(out_name, execute(fn, fargs), args, displays)
     return 0
 
 

@@ -1,0 +1,223 @@
+"""Terminal widget renderers for vis.json display specs.
+
+Reference: the Live UI renders vis widgets with Vega charts, request graphs,
+and flamegraphs (src/api/proto/vispb/vis.proto:58-303,
+src/ui/src/containers/live-widgets/) — this is the CLI-native equivalent:
+braille timeseries, folded-stack flamegraphs, horizontal bar charts, and
+edge lists, falling back to the aligned table for everything else.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# --------------------------------------------------------------- braille
+#: braille dot bit for (x in 0..1, y in 0..3) within one cell
+_DOT_BITS = ((0x01, 0x02, 0x04, 0x40), (0x08, 0x10, 0x20, 0x80))
+
+
+class BrailleCanvas:
+    """width×height CHARACTER canvas with 2×4 braille dots per character."""
+
+    def __init__(self, width: int, height: int):
+        self.w, self.h = width, height
+        self.cells = [[0] * width for _ in range(height)]
+
+    def dot(self, px: int, py: int) -> None:
+        """Plot dot at pixel (px ∈ [0, 2w), py ∈ [0, 4h)), y=0 at BOTTOM."""
+        if not (0 <= px < 2 * self.w and 0 <= py < 4 * self.h):
+            return
+        flipped = 4 * self.h - 1 - py
+        self.cells[flipped // 4][px // 2] |= _DOT_BITS[px % 2][flipped % 4]
+
+    def lines(self) -> list[str]:
+        return ["".join(chr(0x2800 + c) for c in row) for row in self.cells]
+
+
+def _fmt_val(v: float) -> str:
+    a = abs(v)
+    for suffix, div in (("G", 1e9), ("M", 1e6), ("k", 1e3)):
+        if a >= div:
+            return f"{v / div:.4g}{suffix}"
+    return f"{v:.4g}"
+
+
+def render_timeseries(result, display: dict, width: int = 72,
+                      height: int = 12) -> str:
+    """TimeseriesChart: braille plot of value-vs-time (vispb
+    TimeseriesChart: value/series/mode).  Series overlay on one canvas
+    undistinguished — braille has no color — with the series count noted
+    in the caption."""
+    specs = display.get("timeseries") or []
+    if not specs or result.num_rows == 0 or "time_" not in result.columns:
+        return ""
+    t = np.asarray(result.columns["time_"], dtype=np.float64)
+    t0, t1 = t.min(), t.max()
+    span = max(t1 - t0, 1.0)
+    out = []
+    for spec in specs:
+        vcol = spec.get("value")
+        scol = spec.get("series") or None
+        if vcol not in result.columns:
+            continue
+        v = np.asarray(result.decoded(vcol), dtype=np.float64)
+        finite = np.isfinite(v)
+        if not finite.any():
+            continue
+        lo, hi = float(v[finite].min()), float(v[finite].max())
+        vspan = max(hi - lo, 1e-12)
+        canvas = BrailleCanvas(width, height)
+        series_vals = ["*"]
+        if scol is not None and scol in result.columns:
+            series_vals = sorted(set(map(str, result.decoded(scol))))
+        for i in range(len(v)):
+            if not finite[i]:
+                continue
+            px = int((t[i] - t0) / span * (2 * width - 1))
+            py = int((v[i] - lo) / vspan * (4 * height - 1))
+            canvas.dot(px, py)
+        ylab_hi, ylab_lo = _fmt_val(hi), _fmt_val(lo)
+        pad = max(len(ylab_hi), len(ylab_lo))
+        rows = canvas.lines()
+        body = []
+        for r, line in enumerate(rows):
+            if r == 0:
+                label = ylab_hi.rjust(pad)
+            elif r == len(rows) - 1:
+                label = ylab_lo.rjust(pad)
+            else:
+                label = " " * pad
+            body.append(f"{label} |{line}")
+        dur_s = span / 1e9
+        body.append(" " * pad + " +" + "-" * width)
+        body.append(" " * pad + f"  {vcol} over {dur_s:.0f}s"
+                    + (f", {len(series_vals)} series ({scol})"
+                       if scol else ""))
+        out.append("\n".join(body))
+    return "\n".join(out)
+
+
+def render_flamegraph(result, display: dict, width: int = 96,
+                      max_depth: int = 30, min_pct: float = 0.5) -> str:
+    """StackTraceFlameGraph: folded stacks ('a;b;c' + count) → tree with
+    width-scaled bars and cumulative percentages."""
+    scol = display.get("stacktraceColumn", "stack_trace")
+    ccol = display.get("countColumn", "count")
+    if scol not in result.columns or result.num_rows == 0:
+        return ""
+    stacks = result.decoded(scol)
+    counts = np.asarray(result.decoded(ccol), dtype=np.float64) \
+        if ccol in result.columns else np.ones(len(stacks))
+
+    root: dict = {"n": 0.0, "kids": {}}
+    for stack, c in zip(stacks, counts):
+        node = root
+        node["n"] += c
+        for frame in str(stack).split(";"):
+            frame = frame.strip()
+            if not frame:
+                continue
+            node = node["kids"].setdefault(frame, {"n": 0.0, "kids": {}})
+            node["n"] += c
+    total = root["n"] or 1.0
+
+    lines = [f"flamegraph: {int(total)} samples"]
+
+    def walk(node, depth):
+        if depth > max_depth:
+            return
+        kids = sorted(node["kids"].items(), key=lambda kv: -kv[1]["n"])
+        for name, k in kids:
+            pct = 100.0 * k["n"] / total
+            if pct < min_pct:
+                continue
+            bar_w = max(1, int(pct / 100.0 * (width - 2 * depth)))
+            label = f"{name} {pct:.1f}%"
+            bar = "█" * min(bar_w, max(width - 2 * depth, 4))
+            lines.append("  " * depth + f"{bar} {label}")
+            walk(k, depth + 1)
+
+    walk(root, 0)
+    return "\n".join(lines)
+
+
+def render_bars(result, display: dict, width: int = 60,
+                max_rows: int = 24) -> str:
+    """BarChart / HistogramChart: horizontal bars (vispb BarChart bar.value/
+    bar.label; HistogramChart histogram.value with label falling back to the
+    first string column)."""
+    bar = display.get("bar") or {}
+    vcol, lcol = bar.get("value"), bar.get("label")
+    if not vcol:
+        hist = display.get("histogram") or {}
+        vcol = hist.get("value")
+        lcol = next((c for c in result.relation.names()
+                     if c in result.dictionaries), None)
+    if not vcol or vcol not in result.columns or result.num_rows == 0:
+        return ""
+    v = np.asarray(result.decoded(vcol), dtype=np.float64)
+    labels = ([str(x) for x in result.decoded(lcol)]
+              if lcol and lcol in result.columns
+              else [str(i) for i in range(len(v))])
+    order = np.argsort(-v)[:max_rows]
+    vmax = max(float(v[order[0]]), 1e-12) if len(order) else 1.0
+    pad = max((len(labels[i]) for i in order), default=0)
+    lines = []
+    for i in order:
+        w = max(1, int(v[i] / vmax * width)) if v[i] > 0 else 0
+        lines.append(f"{labels[i].rjust(pad)} |{'█' * w} {_fmt_val(float(v[i]))}")
+    return "\n".join(lines)
+
+
+def render_graph(result, display: dict, max_edges: int = 40) -> str:
+    """Graph / RequestGraph: edge list with optional edge metrics."""
+    g = display.get("requestGraph") or display.get("graph") or {}
+    src = (g.get("requestorPodColumn") or g.get("requestorServiceColumn")
+           or g.get("fromColumn"))
+    dst = (g.get("responderPodColumn") or g.get("responderServiceColumn")
+           or g.get("toColumn"))
+    if not src or not dst or src not in result.columns \
+            or dst not in result.columns:
+        # guess the first two string columns
+        strcols = [c for c in result.relation.names()
+                   if c in result.dictionaries]
+        if len(strcols) < 2 or result.num_rows == 0:
+            return ""
+        src, dst = strcols[0], strcols[1]
+    a = [str(x) for x in result.decoded(src)]
+    b = [str(x) for x in result.decoded(dst)]
+    metric = next((c for c in result.relation.names()
+                   if c not in (src, dst, "time_")
+                   and np.issubdtype(np.asarray(result.columns[c]).dtype,
+                                     np.number)
+                   and c not in result.dictionaries), None)
+    m = result.decoded(metric) if metric else None
+    pad = max((len(x) for x in a), default=0)
+    lines = []
+    for i in range(min(len(a), max_edges)):
+        extra = f"  [{metric}={_fmt_val(float(m[i]))}]" if m is not None else ""
+        lines.append(f"{a[i].rjust(pad)} ──▶ {b[i]}{extra}")
+    if len(a) > max_edges:
+        lines.append(f"... ({len(a) - max_edges} more edges)")
+    return "\n".join(lines)
+
+
+#: widget kind → renderer (None = fall back to the plain table)
+RENDERERS = {
+    "TimeseriesChart": render_timeseries,
+    "StackTraceFlameGraph": render_flamegraph,
+    "BarChart": render_bars,
+    "HistogramChart": render_bars,
+    "RequestGraph": render_graph,
+    "Graph": render_graph,
+}
+
+
+def render_widget(kind: str, display: dict, result) -> str:
+    """'' when no renderer applies (caller falls back to the table)."""
+    fn = RENDERERS.get(kind)
+    if fn is None:
+        return ""
+    try:
+        return fn(result, display)
+    except Exception:
+        return ""  # a rendering bug must never hide the data: show the table

@@ -46,6 +46,8 @@ class Widget:
     kind: str  # short display-spec type, e.g. "TimeseriesChart"
     func: Optional[FuncCall]
     global_output: Optional[str]
+    #: raw displaySpec dict (column bindings for renderers)
+    display: dict = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -92,13 +94,19 @@ class VisSpec:
                     runs.append((w.name, w.func.name, args))
         return runs
 
-    def widget_kinds(self) -> dict[str, str]:
-        """output/widget name -> display kind (table, TimeseriesChart, ...)."""
+    def widget_displays(self) -> dict[str, "Widget"]:
+        """output/widget name -> Widget (kind + display column bindings).
+        Keyed exactly like executions(): globalFuncOutputName for global
+        funcs, the WIDGET name for inline funcs."""
         out = {}
         for w in self.widgets:
             target = w.global_output or w.name
-            out[target] = w.kind
+            out[target] = w
         return out
+
+    def widget_kinds(self) -> dict[str, str]:
+        """output/widget name -> display kind (table, TimeseriesChart, ...)."""
+        return {name: w.kind for name, w in self.widget_displays().items()}
 
 
 def _parse_func(d: dict) -> FuncCall:
@@ -134,5 +142,6 @@ def parse_vis(data) -> VisSpec:
             name=w.get("name", ""), kind=kind,
             func=_parse_func(w["func"]) if "func" in w else None,
             global_output=w.get("globalFuncOutputName"),
+            display=dict(w.get("displaySpec", {})),
         ))
     return VisSpec(variables=variables, global_funcs=gfuncs, widgets=widgets)

@@ -1,0 +1,102 @@
+"""Terminal widget renderers (vis.proto display specs → text charts)."""
+import numpy as np
+
+from pixie_tpu.cli_widgets import (
+    BrailleCanvas,
+    render_bars,
+    render_flamegraph,
+    render_graph,
+    render_timeseries,
+    render_widget,
+)
+from pixie_tpu.engine.result import QueryResult
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import ColumnSchema, DataType as DT, Relation
+
+
+def _qr(cols: dict, strings=()):
+    dicts = {}
+    out = {}
+    schema = []
+    for name, vals in cols.items():
+        if name in strings:
+            d = Dictionary(sorted(set(vals)))
+            dicts[name] = d
+            out[name] = d.encode(list(vals))
+            schema.append(ColumnSchema(name, DT.STRING))
+        else:
+            arr = np.asarray(vals)
+            out[name] = arr
+            schema.append(ColumnSchema(
+                name, DT.FLOAT64 if arr.dtype.kind == "f" else DT.INT64))
+    return QueryResult(name="t", relation=Relation(schema), columns=out,
+                       dictionaries=dicts)
+
+
+def test_braille_canvas_corners():
+    c = BrailleCanvas(2, 1)
+    c.dot(0, 0)       # bottom-left
+    c.dot(3, 3)       # top-right
+    lines = c.lines()
+    assert len(lines) == 1 and len(lines[0]) == 2
+    assert lines[0] != "⠀⠀"  # some dots set
+
+
+def test_timeseries_renders_and_scales():
+    n = 50
+    qr = _qr({
+        "time_": np.arange(n, dtype=np.int64) * 1_000_000_000,
+        "v": np.sin(np.arange(n) / 5.0) * 100 + 100,
+        "svc": ["a" if i % 2 else "b" for i in range(n)],
+    }, strings=("svc",))
+    out = render_timeseries(qr, {"timeseries": [
+        {"value": "v", "series": "svc"}]})
+    assert "v over" in out and "2 series (svc)" in out
+    assert any(ch != "⠀" and 0x2800 <= ord(ch) < 0x2900
+               for line in out.splitlines() for ch in line)
+
+
+def test_flamegraph_tree_percentages():
+    qr = _qr({
+        "stack_trace": ["main;run;work", "main;run;idle", "main;gc",
+                        "main;run;work"],
+        "count": [40, 30, 30, 20],
+    }, strings=("stack_trace",))
+    out = render_flamegraph(qr, {"stacktraceColumn": "stack_trace",
+                                 "countColumn": "count"})
+    assert "main 100.0%" in out
+    assert "run 75.0%" in out
+    assert "work 50.0%" in out
+    assert "gc 25.0%" in out
+    # deeper frames indent under their parents
+    lines = out.splitlines()
+    main_i = next(i for i, l in enumerate(lines) if "main 100" in l)
+    run_i = next(i for i, l in enumerate(lines) if "run 75" in l)
+    assert run_i > main_i
+    assert lines[run_i].startswith("  ")
+
+
+def test_bars_sorted_desc():
+    qr = _qr({"n": [5, 50, 20], "svc": ["a", "b", "c"]}, strings=("svc",))
+    out = render_bars(qr, {"bar": {"value": "n", "label": "svc"}})
+    lines = out.splitlines()
+    assert lines[0].lstrip().startswith("b |")
+    assert "50" in lines[0]
+
+
+def test_graph_edges():
+    qr = _qr({
+        "requestor": ["frontend", "frontend"],
+        "responder": ["cart", "db"],
+        "rps": [10.0, 3.0],
+    }, strings=("requestor", "responder"))
+    out = render_graph(qr, {"requestGraph": {
+        "requestorPodColumn": "requestor", "responderPodColumn": "responder"}})
+    assert "frontend ──▶ cart" in out
+    assert "rps=10" in out
+
+
+def test_render_widget_falls_back_cleanly():
+    qr = _qr({"x": [1, 2]})
+    assert render_widget("Table", {}, qr) == ""
+    assert render_widget("TimeseriesChart", {}, qr) == ""  # no time_ col
