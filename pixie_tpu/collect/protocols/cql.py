@@ -100,16 +100,31 @@ class CQLParser(ProtocolParser):
     def stitch(self, requests, responses, state=None):
         records = []
         errors = 0
-        pending = {r.stream: r for r in requests}
+        # FIFO queue per stream id: two in-flight requests reusing one stream
+        # id within a round must match their responses in order (latest-wins
+        # would pair the newer request with the older response's latency).
+        pending: dict[int, deque] = {}
+        for r in requests:
+            pending.setdefault(r.stream, deque()).append(r)
         matched_req = set()
         for resp in responses:
             if resp.opcode == OP_EVENT:  # server push, no request
                 records.append((None, resp))
                 continue
-            req = pending.pop(resp.stream, None)
-            if req is None:
+            q = pending.get(resp.stream)
+            if not q:
                 errors += 1
                 continue
+            req = q.popleft()
+            # Self-heal after a lost response: a NEWER request strictly older
+            # than this response on the same stream id means the head's
+            # response was dropped (CQL forbids two in-flight per id) — the
+            # stale head must not shift every later pairing on this stream.
+            while q and req.timestamp_ns and \
+                    req.timestamp_ns < q[0].timestamp_ns <= resp.timestamp_ns:
+                errors += 1
+                matched_req.add(id(req))  # abandoned: leave the deque too
+                req = q.popleft()
             matched_req.add(id(req))
             records.append((req, resp))
         # Every response resolves this round (matched, push, or orphan);

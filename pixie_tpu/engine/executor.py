@@ -161,44 +161,70 @@ _KEY_OVERFLOW = "overflow"
 
 
 def _int_key_uniques(table, col: str, src) -> Optional[np.ndarray]:
-    """Cumulative sorted unique values of `col`, scanned from THIS query's
-    snapshot cursor past the cached row-id watermark.
+    """Cumulative sorted unique values of `col` over a contiguous covered
+    row-id range [lo, hi), extended/rebased from THIS query's snapshot cursor.
 
     Scanning the live table instead of the snapshot would race ring-buffer
     expiry: a value pinned in the query's feed could be missing from the
     fresh scan and searchsorted would silently fold its rows into a
     neighboring group.  Rows are immutable and row ids monotone, so values
-    below the watermark were observed live by the scan that covered them —
-    any later snapshot's old rows are a subset.  Returns None when the set
-    overflows _KEY_UNIQUES_CAP (caller falls back to per-query prescan /
-    sorted agg).
+    inside [lo, hi) were observed live by the scan that covered them — any
+    snapshot whose rows all sit in [lo, hi) gets a valid (possibly strict
+    superset) value set.  Returns None when the set overflows
+    _KEY_UNIQUES_CAP (caller falls back to per-query prescan / sorted agg).
+
+    Coverage rules (advisor r3 high + r4 review finding):
+      * time-bounded cursors skip whole live batches — they neither consult
+        nor update the cache (caller prescans this query's own snapshot);
+      * a cursor reaching BELOW lo (an old pinned snapshot after a rebase)
+        gets None — its rows may hold values the cache never saw;
+      * a cursor starting past hi (expiry gap [hi, start) was never scanned)
+        REBASES the entry to its own contiguous coverage instead of killing
+        the cache for the table's remaining lifetime — expired rows can only
+        be yielded by older pinned cursors, which the lo bound now rejects.
     """
+    if (getattr(src, "start_time", None) is not None
+            or getattr(src, "stop_time", None) is not None):
+        return None
+    if getattr(src, "since_row_id", None) is None:
+        return None  # not a table Cursor — no coverage guarantee
+    items = [(rb, rid) for rb, rid, _gen in src]
     key = (table.uid, col)
     with _CACHE_LOCK:
-        vals, hi = _KEY_UNIQUES.get(key, (None, 0))
+        entry = _KEY_UNIQUES.get(key)
+    vals, lo, hi = entry if entry is not None else (None, 0, 0)
     if vals is _KEY_OVERFLOW:
         return None
-    parts = [] if vals is None else [vals]
-    seen_hi = hi
-    changed = vals is None
-    for rb, rid, _gen in src:
+    cfirst = min((rid for _rb, rid in items), default=None)
+    if cfirst is None:  # empty snapshot: nothing to encode, superset is fine
+        return vals if vals is not None else np.empty(0, dtype=np.int64)
+    if vals is not None and cfirst < lo:
+        return None  # pinned rows below cached coverage: prescan, keep entry
+    rebase = vals is None or cfirst > hi
+    parts = [] if rebase else [vals]
+    cover = cfirst if rebase else hi
+    base_lo = cfirst if rebase else lo
+    changed = rebase
+    for rb, rid in items:  # a cursor's batches are row-contiguous
         end = rid + rb.num_valid
-        if end <= hi:
+        if end <= cover:
             continue
-        lo = max(0, hi - rid)
-        arr = rb.columns[col][lo: rb.num_valid]
+        if rid > cover:
+            return None  # non-contiguous cursor (unexpected): refuse
+        off = max(0, cover - rid)
+        arr = rb.columns[col][off: rb.num_valid]
         if len(arr):
             parts.append(np.unique(arr))
             changed = True
-        seen_hi = max(seen_hi, end)
+        cover = end
     if changed:
         vals = (np.unique(np.concatenate(parts)) if parts
                 else np.empty(0, dtype=np.int64))
         with _CACHE_LOCK:
             if len(vals) > _KEY_UNIQUES_CAP:
-                _KEY_UNIQUES[key] = (_KEY_OVERFLOW, seen_hi)
+                _KEY_UNIQUES[key] = (_KEY_OVERFLOW, base_lo, cover)
                 return None
-            _KEY_UNIQUES[key] = (vals, seen_hi)
+            _KEY_UNIQUES[key] = (vals, base_lo, cover)
             while len(_KEY_UNIQUES) > _KEY_UNIQUES_MAX:
                 _KEY_UNIQUES.popitem(last=False)
     return vals

@@ -27,6 +27,7 @@ from pixie_tpu.plan.plan import (
     FilterOp,
     JoinOp,
     LimitOp,
+    Literal,
     MapOp,
     MemorySourceOp,
     RemoteSourceOp,
@@ -38,40 +39,80 @@ from pixie_tpu.types import Relation, SemanticType as ST
 _NONE = ST.ST_NONE
 
 
-def _call_st(expr: Call, env: dict, registry) -> ST:
-    udf = None
+def _expr_dt(expr, dtenv: dict, registry):
+    """Physical dtype of an expression, or None when unresolvable — used to
+    pick the same scalar overload the executor will run."""
+    if isinstance(expr, Column):
+        return dtenv.get(expr.name)
+    if isinstance(expr, Literal):
+        return expr.dtype
+    if isinstance(expr, Call):
+        argdts = [_expr_dt(a, dtenv, registry) for a in expr.args]
+        if any(d is None for d in argdts):
+            return None
+        try:
+            return registry.scalar(expr.fn, argdts).out_type
+        except Exception:
+            return None
+    return None
+
+
+def _call_st(expr: Call, env: dict, dtenv: dict, registry) -> ST:
     try:
         overloads = registry._scalar.get(expr.fn) or []
-        udf = overloads[0] if overloads else None
     except AttributeError:  # registry without scalar table
-        udf = None
-    if udf is not None and udf.out_st is not None:
+        overloads = []
+    if not overloads:
+        return _NONE
+    # Resolve the overload by the call's argument dtypes — overloads of one
+    # name may declare different out_st/st_preserve, and the first-listed one
+    # is not necessarily the one the executor dispatches.
+    udf = None
+    argdts = [_expr_dt(a, dtenv, registry) for a in expr.args]
+    if all(d is not None for d in argdts):
+        try:
+            udf = registry.scalar(expr.fn, argdts)
+        except Exception:
+            udf = None
+    if udf is None:
+        # dtypes unresolvable here: the ST metadata is only trustworthy when
+        # every overload agrees on it.
+        if len({(o.out_st, o.st_preserve) for o in overloads}) != 1:
+            return _NONE
+        udf = overloads[0]
+    if udf.out_st is not None:
         return udf.out_st
-    if udf is not None and udf.st_preserve:
+    if udf.st_preserve:
         for a in expr.args:
-            st = _expr_st(a, env, registry)
+            st = _expr_st(a, env, dtenv, registry)
             if st != _NONE:
                 return st
     return _NONE
 
 
-def _expr_st(expr, env: dict, registry) -> ST:
+def _expr_st(expr, env: dict, dtenv: dict, registry) -> ST:
     if isinstance(expr, Column):
         return env.get(expr.name, _NONE)
     if isinstance(expr, Call):
-        return _call_st(expr, env, registry)
+        return _call_st(expr, env, dtenv, registry)
     return _NONE
 
 
 def semantic_types(plan, op, store, registry, memo: Optional[dict] = None
                    ) -> dict:
     """{column: SemanticType} of `op`'s output."""
-    if memo is None:
-        memo = {}
+    return _type_envs(plan, op, store, registry,
+                      memo if memo is not None else {})[0]
+
+
+def _type_envs(plan, op, store, registry, memo: dict) -> tuple[dict, dict]:
+    """(semantic-type env, physical-dtype env) of `op`'s output.  The dtype
+    env exists so Call STs resolve the overload the executor dispatches."""
     got = memo.get(op.id)
     if got is not None:
         return got
     out: dict = {}
+    dts: dict = {}
     if isinstance(op, MemorySourceOp):
         try:
             rel = store.table(op.table).relation
@@ -80,25 +121,33 @@ def semantic_types(plan, op, store, registry, memo: Optional[dict] = None
         if rel is not None:
             cols = op.columns or rel.names()
             out = {c.name: c.semantic_type for c in rel if c.name in cols}
+            dts = {c.name: c.data_type for c in rel if c.name in cols}
     elif isinstance(op, (UDTFSourceOp, RemoteSourceOp)):
+        rel = None
         if op.schema is not None:
             rel = Relation.from_dict(op.schema)
-            out = {c.name: c.semantic_type for c in rel}
         elif isinstance(op, UDTFSourceOp):
             try:
                 rel = registry.udtf(op.name).relation
-                out = {c.name: c.semantic_type for c in rel}
             except Exception:
-                out = {}
+                rel = None
+        if rel is not None:
+            out = {c.name: c.semantic_type for c in rel}
+            dts = {c.name: c.data_type for c in rel}
     elif isinstance(op, MapOp):
-        env = semantic_types(plan, plan.parents(op)[0], store, registry, memo)
-        out = {name: _expr_st(e, env, registry) for name, e in op.exprs}
+        env, dtenv = _type_envs(plan, plan.parents(op)[0], store, registry,
+                                memo)
+        out = {name: _expr_st(e, env, dtenv, registry) for name, e in op.exprs}
+        dts = {name: _expr_dt(e, dtenv, registry) for name, e in op.exprs}
     elif isinstance(op, (FilterOp, LimitOp)):
-        out = dict(semantic_types(plan, plan.parents(op)[0], store, registry,
-                                  memo))
+        env, dtenv = _type_envs(plan, plan.parents(op)[0], store, registry,
+                                memo)
+        out, dts = dict(env), dict(dtenv)
     elif isinstance(op, AggOp):
-        env = semantic_types(plan, plan.parents(op)[0], store, registry, memo)
+        env, dtenv = _type_envs(plan, plan.parents(op)[0], store, registry,
+                                memo)
         out = {g: env.get(g, _NONE) for g in op.groups}
+        dts = {g: dtenv.get(g) for g in op.groups}
         for ae in op.values:
             st = _NONE
             try:
@@ -115,26 +164,34 @@ def semantic_types(plan, op, store, registry, memo: Optional[dict] = None
                         st = ST.ST_DURATION_NS_QUANTILES
                 elif uda.st_preserve and ae.arg is not None:
                     st = env.get(ae.arg, _NONE)
+                try:
+                    dts[ae.out_name] = uda.out_type(dtenv.get(ae.arg))
+                except Exception:
+                    dts[ae.out_name] = None
             out[ae.out_name] = st
     elif isinstance(op, JoinOp):
         left, right = plan.parents(op)
-        lenv = semantic_types(plan, left, store, registry, memo)
-        renv = semantic_types(plan, right, store, registry, memo)
+        lenv, ldt = _type_envs(plan, left, store, registry, memo)
+        renv, rdt = _type_envs(plan, right, store, registry, memo)
         if op.output:
             for side, col, out_name in op.output:
-                env = lenv if side == "left" else renv
+                env, dtenv = (lenv, ldt) if side == "left" else (renv, rdt)
                 out[out_name] = env.get(col, _NONE)
+                dts[out_name] = dtenv.get(col)
         else:
             out = {**renv, **lenv}
+            dts = {**rdt, **ldt}
     elif isinstance(op, UnionOp):
-        out = dict(semantic_types(plan, plan.parents(op)[0], store, registry,
-                                  memo))
+        env, dtenv = _type_envs(plan, plan.parents(op)[0], store, registry,
+                                memo)
+        out, dts = dict(env), dict(dtenv)
     else:  # unknown op kinds contribute nothing rather than failing queries
         parents = plan.parents(op)
         if parents:
-            out = dict(semantic_types(plan, parents[0], store, registry, memo))
-    memo[op.id] = out
-    return out
+            env, dtenv = _type_envs(plan, parents[0], store, registry, memo)
+            out, dts = dict(env), dict(dtenv)
+    memo[op.id] = (out, dts)
+    return out, dts
 
 
 class SchemaStore:

@@ -115,6 +115,9 @@ class StreamQuery:
         self.registry = registry or default_registry
         self.lateness_ns = int(lateness_ns)
         self.closed = False
+        #: per-sink end tokens snapshotted by freeze(); None = live (polls
+        #: read to the table head).  Bounds close() under concurrent writers.
+        self._ends: Optional[dict] = None
         #: the logical plan — kept for semantic-type restamping of emissions
         #: (post plans read a channel source with no ST knowledge)
         self.plan = plan
@@ -225,13 +228,36 @@ class StreamQuery:
         for pl in self.pipelines:
             if pl.done:
                 continue
-            if self.store.table(pl.source.table).last_row_id() > pl.token:
+            if self._bounded_last(pl) > pl.token:
                 return True
         return False
 
+    def freeze(self) -> None:
+        """Snapshot per-pipeline end tokens: later polls stop at rows that
+        exist NOW.  Without this, close()'s drain loop re-reads the live
+        table head each iteration and never terminates against a writer
+        sustaining more than MAX_POLL_ROWS per poll."""
+        if self._ends is None:
+            self._ends = {
+                pl.sink_name: self.store.table(pl.source.table).last_row_id()
+                for pl in self.pipelines
+            }
+
+    def _end_for(self, pl) -> Optional[int]:
+        return None if self._ends is None else self._ends.get(pl.sink_name)
+
+    def _bounded_last(self, pl) -> int:
+        """Newest row id this pipeline may read: the live table head, clamped
+        to the freeze() end token once one exists."""
+        last = self.store.table(pl.source.table).last_row_id()
+        end = self._end_for(pl)
+        return last if end is None else min(last, end)
+
     def close(self) -> dict[str, QueryResult]:
-        """End of stream: drain everything unprocessed, then flush open
-        windows / non-windowed agg state."""
+        """End of stream: drain everything unprocessed (up to the rows that
+        existed at close entry), then flush open windows / non-windowed agg
+        state."""
+        self.freeze()
         out = self.poll()
         while self.lagging():
             got = self.poll()
@@ -256,8 +282,7 @@ class StreamQuery:
     def _poll_pipeline(self, pl: _Pipeline) -> Optional[QueryResult]:
         if pl.done:
             return None
-        table = self.store.table(pl.source.table)
-        hi = min(table.last_row_id(), pl.token + self.MAX_POLL_ROWS)
+        hi = min(self._bounded_last(pl), pl.token + self.MAX_POLL_ROWS)
         if hi <= pl.token:
             return None
         pl.source.since_row_id = pl.token
@@ -336,8 +361,7 @@ class StreamQuery:
         for pl in self.pipelines:
             if pl.agg is None:
                 continue  # chain pipelines stream rows via poll()
-            table = self.store.table(pl.source.table)
-            hi = min(table.last_row_id(), pl.token + self.MAX_POLL_ROWS)
+            hi = min(self._bounded_last(pl), pl.token + self.MAX_POLL_ROWS)
             if hi <= pl.token:
                 continue
             pl.source.since_row_id = pl.token

@@ -165,6 +165,10 @@ class ClusterStreamQuery:
         return any(sq.lagging() for sq in self._agent_sqs.values())
 
     def close(self) -> dict[str, QueryResult]:
+        # Freeze every agent's end tokens first: the drain below must target
+        # the rows that exist NOW, not chase concurrent writers forever.
+        for sq in self._agent_sqs.values():
+            sq.freeze()
         out = self.poll()
         # Drain everything left behind the per-poll cap before flushing —
         # one poll is no longer guaranteed to reach last_row_id.

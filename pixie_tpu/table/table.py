@@ -92,6 +92,13 @@ class Table:
 
         Reference: Table::WriteRowBatch / TransferRecordBatch (table.h:152-155).
         Encodes dict-typed columns; seals full `batch_rows` chunks.
+
+        OWNERSHIP: write() takes ownership of any numpy arrays passed in —
+        matching-dtype arrays are aliased, not copied, and sealed batches are
+        views into them (see _seal_full_locked for why).  Callers must not
+        mutate an array after passing it here; non-dict ndarray columns are
+        marked read-only at write time so violation raises instead of
+        corrupting sealed (and device-cached) data.
         """
         # Validate shape before touching dictionaries: a rejected write must not
         # leak values into the append-only dictionaries.
@@ -110,7 +117,14 @@ class Table:
             if c.name in self.dictionaries:
                 cols[c.name] = self.dictionaries[c.name].encode(v)
             else:
-                cols[c.name] = np.asarray(v, dtype=STORAGE_DTYPE[c.data_type])
+                arr = np.asarray(v, dtype=STORAGE_DTYPE[c.data_type])
+                # Enforce the take-ownership contract: freezing the (possibly
+                # aliased) array makes a caller's post-write mutation raise.
+                # Only freezing base-owning arrays: a read-only view would not
+                # stop writes through the caller's base anyway.
+                if arr.base is None:
+                    arr.flags.writeable = False
+                cols[c.name] = arr
         if not n:
             return 0
         with self._lock:
@@ -254,7 +268,7 @@ class Table:
                     hot = RowBatch(self.relation, merged)
                     hot_row_id += lo_off
         return Cursor(self, items, hot, hot_row_id, start_time, stop_time,
-                      is_delta=True)
+                      is_delta=True, since_row_id=row_id)
 
     # ------------------------------------------------------------------ stats
     def stats(self) -> dict:
@@ -288,10 +302,14 @@ class Cursor:
     """
 
     def __init__(self, table, sealed, hot, hot_row_id, start_time, stop_time,
-                 is_delta: bool = False):
+                 is_delta: bool = False, since_row_id: int = 0):
         self.table = table
         self.start_time = start_time
         self.stop_time = stop_time
+        #: first row id this cursor can yield (0 = scans from the table head);
+        #: the executor's key-uniques cache requires full coverage and only
+        #: trusts cursors whose since_row_id is at/below its watermark.
+        self.since_row_id = since_row_id
         #: row-id-bounded incremental scan (streaming): its feeds are read
         #: ONCE and must never enter the device feed cache — caching every
         #: poll's delta fills the cache with dead entries (measured: poll

@@ -1,0 +1,336 @@
+"""Regression tests for round-3 advisor findings.
+
+Each test encodes a bug that shipped in round 3 and the contract that fixes
+it: key-uniques cache coverage (pruned cursors must not advance the
+watermark), table write() ownership, bounded stream close(), ST overload
+resolution, and CQL per-stream FIFO stitching.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.plan import (
+    AggExpr,
+    AggOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+
+def _groupby_count_plan(start_time=None, stop_time=None):
+    p = Plan()
+    src = p.add(
+        MemorySourceOp(table="t", start_time=start_time, stop_time=stop_time)
+    )
+    a = p.add(
+        AggOp(groups=["k"], values=[AggExpr("cnt", "count", None)]),
+        parents=[src],
+    )
+    p.add(MemorySinkOp(name="output"), parents=[a])
+    return p
+
+
+def _result_df(res):
+    return res.to_pandas().sort_values("k").reset_index(drop=True)
+
+
+class TestKeyUniquesCoverage:
+    """A time-bounded scan skips whole live sealed batches; its key scan must
+    not populate the table-lifetime uniques cache with a full-table watermark
+    (advisor high finding, executor.py _int_key_uniques)."""
+
+    def _table(self):
+        ts = TableStore()
+        rel = Relation.of(
+            ("time_", DT.TIME64NS), ("k", DT.INT64), ("v", DT.FLOAT64)
+        )
+        t = ts.create("t", rel, batch_rows=256)
+        # sealed batch 1: early times, key 1 only
+        t.write(
+            {
+                "time_": np.arange(256, dtype=np.int64) * 10,
+                "k": np.full(256, 1, dtype=np.int64),
+                "v": np.ones(256),
+            }
+        )
+        # sealed batch 2: late times, key 7 only
+        t.write(
+            {
+                "time_": np.arange(256, dtype=np.int64) * 10 + 10_000_000,
+                "k": np.full(256, 7, dtype=np.int64),
+                "v": np.ones(256),
+            }
+        )
+        return ts
+
+    def test_time_pruned_then_wide(self):
+        ts = self._table()
+        # time-bounded query scans ONLY the late batch
+        res1 = execute_plan(_groupby_count_plan(start_time=10_000_000), ts)[
+            "output"
+        ]
+        d1 = _result_df(res1)
+        assert d1["k"].tolist() == [7]
+        assert d1["cnt"].tolist() == [256]
+        # a later full-range query must still see key 1 in its own group —
+        # round 3 folded its rows into key 7's LUT slot
+        res2 = execute_plan(_groupby_count_plan(), ts)["output"]
+        d2 = _result_df(res2)
+        assert d2["k"].tolist() == [1, 7]
+        assert d2["cnt"].tolist() == [256, 256]
+
+    def test_expiry_gap_blocks_cache_extension(self):
+        """Ring-buffer expiry below the watermark leaves a coverage gap; the
+        cache must refuse to extend over it — an older pinned snapshot may
+        still hold the expired rows (code-review finding, round 4)."""
+        from pixie_tpu.engine.executor import _int_key_uniques
+
+        ts = TableStore()
+        rel = Relation.of(
+            ("time_", DT.TIME64NS), ("k", DT.INT64), ("v", DT.FLOAT64)
+        )
+        # budget fits ~2 sealed batches of 256 rows x 3 int64 cols
+        t = ts.create("t", rel, batch_rows=256, max_bytes=2 * 256 * 24 + 64)
+
+        def write(key):
+            t.write(
+                {
+                    "time_": np.arange(256, dtype=np.int64),
+                    "k": np.full(256, key, dtype=np.int64),
+                    "v": np.ones(256),
+                }
+            )
+
+        write(1)
+        pinned = t.cursor()  # pins the key-1 batch
+        write(2)
+        write(3)
+        write(4)  # expiry drops the key-1 (and possibly key-2) batches
+        assert t.stats()["expired_batches"] >= 1
+        fresh = t.cursor()
+        # fresh snapshot starts past the expired range: the cache REBASES to
+        # the fresh contiguous coverage (it must not claim the expired rows)
+        got = _int_key_uniques(t, "k", fresh)
+        assert got is not None
+        live_keys = sorted(
+            {int(k) for rb, _rid, _g in fresh for k in np.unique(rb.columns["k"])}
+        )
+        assert got.tolist() == live_keys
+        assert 1 not in got.tolist()
+        # the pinned snapshot reaches BELOW the rebased coverage: it must be
+        # refused (prescan fallback), not handed a set missing its key 1
+        assert _int_key_uniques(t, "k", pinned) is None
+
+    def test_wide_then_pruned_then_new_keys(self):
+        ts = self._table()
+        res = execute_plan(_groupby_count_plan(), ts)["output"]
+        assert _result_df(res)["k"].tolist() == [1, 7]
+        # pruned query after the cache exists must not advance the watermark
+        execute_plan(_groupby_count_plan(stop_time=1_000_000), ts)
+        t = ts.table("t")
+        t.write(
+            {
+                "time_": np.arange(256, dtype=np.int64) + 20_000_000,
+                "k": np.full(256, 3, dtype=np.int64),
+                "v": np.ones(256),
+            }
+        )
+        res = execute_plan(_groupby_count_plan(), ts)["output"]
+        d = _result_df(res)
+        assert d["k"].tolist() == [1, 3, 7]
+        assert d["cnt"].tolist() == [256] * 3
+
+
+class TestWriteOwnership:
+    def test_post_write_mutation_raises(self):
+        ts = TableStore()
+        rel = Relation.of(("time_", DT.TIME64NS), ("v", DT.FLOAT64))
+        t = ts.create("t", rel, batch_rows=1 << 20)
+        tcol = np.arange(10, dtype=np.int64)
+        vcol = np.ones(10, dtype=np.float64)
+        t.write({"time_": tcol, "v": vcol})
+        # write() takes ownership: the caller's arrays are frozen so sealed
+        # views (and device feed caches keyed by gen) cannot be corrupted
+        with pytest.raises(ValueError):
+            vcol[0] = 99.0
+        with pytest.raises(ValueError):
+            tcol[0] = -1
+
+
+class TestStreamCloseBounded:
+    def test_close_drains_only_to_freeze_point(self):
+        from pixie_tpu.engine.stream import stream_pxl
+
+        ts = TableStore()
+        rel = Relation.of(("time_", DT.TIME64NS), ("v", DT.FLOAT64))
+        t = ts.create("http_events", rel, batch_rows=1024)
+
+        def write(n, t0):
+            t.write(
+                {
+                    "time_": np.arange(t0, t0 + n, dtype=np.int64),
+                    "v": np.ones(n),
+                }
+            )
+
+        sq = stream_pxl(
+            """
+df = px.DataFrame(table='http_events')
+df = df.stream()
+px.display(df, 'out')
+""",
+            ts,
+        )
+        write(100, 0)
+        assert sq.poll()["out"].num_rows == 100
+        # rows written after freeze() are beyond this query's end of stream:
+        # close() must terminate and not include them (round 3's close()
+        # chased the live table head forever under a sustained writer)
+        sq.freeze()
+        write(50, 100)
+        out = sq.close()
+        assert out == {} or out["out"].num_rows == 0
+
+
+class TestCqlStreamReuse:
+    def test_fifo_match_on_reused_stream_id(self):
+        from pixie_tpu.collect.protocols.cql import CQLParser, OP_QUERY, OP_RESULT
+
+        p = CQLParser()
+
+        def req(stream, q):
+            body = len(q).to_bytes(4, "big") + q.encode()
+            return (
+                bytes([0x04, 0, (stream >> 8) & 0xFF, stream & 0xFF, OP_QUERY])
+                + len(body).to_bytes(4, "big")
+                + body
+            )
+
+        def resp(stream):
+            body = (1).to_bytes(4, "big")  # Void result
+            return (
+                bytes([0x84, 0, (stream >> 8) & 0xFF, stream & 0xFF, OP_RESULT])
+                + len(body).to_bytes(4, "big")
+                + body
+            )
+
+        from collections import deque
+
+        reqs, resps = deque(), deque()
+        for raw, mt, sink in (
+            (req(5, "SELECT one"), "req", reqs),
+            (req(5, "SELECT two"), "req", reqs),
+            (resp(5), "resp", resps),
+            (resp(5), "resp", resps),
+        ):
+            from pixie_tpu.collect.protocols.base import MessageType, ParseState
+
+            st, frame, _ = p.parse_frame(
+                MessageType.REQUEST if mt == "req" else MessageType.RESPONSE,
+                memoryview(raw),
+            )
+            assert st is ParseState.SUCCESS
+            sink.append(frame)
+        records, errors = p.stitch(reqs, resps)
+        assert errors == 0
+        assert len(records) == 2
+        # FIFO: the first response pairs with the FIRST in-flight request
+        assert "one" in p._req_body(records[0][0])
+        assert "two" in p._req_body(records[1][0])
+
+    def test_lost_response_does_not_shift_pairings(self):
+        """A dropped response frame must not permanently shift every later
+        req/resp pairing on that stream id (code-review finding, round 4)."""
+        from collections import deque
+
+        from pixie_tpu.collect.protocols.base import MessageType, ParseState
+        from pixie_tpu.collect.protocols.cql import CQLParser, OP_QUERY, OP_RESULT
+
+        p = CQLParser()
+
+        def parse(raw, mt, ts):
+            st, frame, _ = p.parse_frame(mt, memoryview(raw))
+            assert st is ParseState.SUCCESS
+            frame.timestamp_ns = ts
+            return frame
+
+        def req(q):
+            body = len(q).to_bytes(4, "big") + q.encode()
+            return (
+                bytes([0x04, 0, 0, 5, OP_QUERY])
+                + len(body).to_bytes(4, "big")
+                + body
+            )
+
+        def resp():
+            body = (1).to_bytes(4, "big")
+            return (
+                bytes([0x84, 0, 0, 5, OP_RESULT])
+                + len(body).to_bytes(4, "big")
+                + body
+            )
+
+        # reqA at t=100 (its response was lost), reqB at t=200, respB at t=300
+        reqs = deque(
+            [
+                parse(req("SELECT a"), MessageType.REQUEST, 100),
+                parse(req("SELECT b"), MessageType.REQUEST, 200),
+            ]
+        )
+        resps = deque([parse(resp(), MessageType.RESPONSE, 300)])
+        records, errors = p.stitch(reqs, resps)
+        assert errors == 1  # reqA abandoned
+        assert len(records) == 1
+        assert "b" in p._req_body(records[0][0])
+        assert not reqs  # the stale head left the deque
+
+
+class TestSemanticOverloadResolution:
+    def test_call_st_resolves_by_arg_dtype(self):
+        """Two overloads of one name with different st behavior: the ST walk
+        must pick the overload matching the call's argument dtypes."""
+        from pixie_tpu.engine.semantics import semantic_types
+        from pixie_tpu.plan import Column, Call, MapOp
+        from pixie_tpu.types import SemanticType as ST
+        from pixie_tpu.udf import Registry, ScalarUDF
+
+        reg = Registry()
+        reg.register(
+            ScalarUDF(
+                name="mystery",
+                arg_types=(DT.INT64,),
+                out_type=DT.INT64,
+                fn=lambda x: x,
+                out_st=ST.ST_BYTES,
+            )
+        )
+        reg.register(
+            ScalarUDF(
+                name="mystery",
+                arg_types=(DT.FLOAT64,),
+                out_type=DT.FLOAT64,
+                fn=lambda x: x,
+                out_st=ST.ST_DURATION_NS,
+            )
+        )
+        ts = TableStore()
+        rel = Relation.of(("i", DT.INT64), ("f", DT.FLOAT64))
+        ts.create("t", rel)
+        p = Plan()
+        src = p.add(MemorySourceOp(table="t"))
+        m = p.add(
+            MapOp(
+                exprs=[
+                    ("a", Call("mystery", (Column("i"),))),
+                    ("b", Call("mystery", (Column("f"),))),
+                ]
+            ),
+            parents=[src],
+        )
+        sts = semantic_types(p, m, ts, reg)
+        assert sts["a"] == ST.ST_BYTES
+        assert sts["b"] == ST.ST_DURATION_NS
