@@ -173,6 +173,32 @@ SCHEMAS: dict[str, Relation] = {
         ("signal", DT.INT64),
         ("comm", DT.STRING),
     ),
+    # TCP monitor tables.  The reference materializes these dynamically from
+    # bpftrace programs embedded in px/tcp_drops/data.pxl:90 and
+    # px/tcp_retransmits/data.pxl:92-93 (columns = the programs' printf
+    # fields); this build declares them as canonical connector schemas so the
+    # scripts run against a netlink//proc-based drops monitor or replayed
+    # captures without a kernel probe.
+    "tcp_drop_table": _rel(
+        _TIME,
+        ("pid", DT.INT64),
+        ("pid_start_time", DT.INT64),
+        ("src_ip", DT.STRING, ST.ST_IP_ADDRESS),
+        ("src_port", DT.INT64, ST.ST_PORT),
+        ("dst_ip", DT.STRING, ST.ST_IP_ADDRESS),
+        ("dst_port", DT.INT64, ST.ST_PORT),
+        ("state", DT.STRING),
+    ),
+    "tcp_retransmissions": _rel(
+        _TIME,
+        ("pid", DT.INT64),
+        ("pid_start_time", DT.INT64),
+        ("src_ip", DT.STRING, ST.ST_IP_ADDRESS),
+        ("src_port", DT.INT64, ST.ST_PORT),
+        ("dst_ip", DT.STRING, ST.ST_IP_ADDRESS),
+        ("dst_port", DT.INT64, ST.ST_PORT),
+        ("state", DT.STRING),
+    ),
 }
 
 

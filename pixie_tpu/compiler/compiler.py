@@ -257,8 +257,17 @@ def compile_pxl(
             kwargs[k] = _coerce_arg(v, anns.get(k))
         result_df = fn(**kwargs)
 
-    if isinstance(result_df, DataFrame) and not ctx.sinks:
-        result_df.display("output")
+    if isinstance(result_df, DataFrame):
+        # A vis func's RETURN value is always the widget's result table —
+        # px.debug drawers inside the func are additional sinks, not a
+        # substitute (reference: the UI renders the func result regardless).
+        # Skip when the returned frame itself was already displayed, or when
+        # the script claimed the "output" name for a DIFFERENT frame (two
+        # same-named sinks would silently shadow one another in results).
+        sunk = {id(p) for s in ctx.sinks for p in ctx.plan.parents(s)}
+        names = {getattr(s, "name", None) for s in ctx.sinks}
+        if id(result_df._node) not in sunk and "output" not in names:
+            result_df.display("output")
     if not ctx.sinks:
         raise CompilerError(
             "script produced no output: call px.display(df, name) or return a DataFrame"

@@ -45,6 +45,13 @@ class SVal:
     dtype: DT
     build: Callable  # env -> jax.Array
     dictionary: Optional[Dictionary] = None  # for STRING / UINT128 values
+    #: (root_dict, root_col, fn, codes_build) when this value is a PURE
+    #: per-dictionary-value function of one dict-encoded source column:
+    #: value_for_row = fn(root_dict.value(codes_build(env)[row])).  Lets a
+    #: later host call with several non-literal args that all derive from the
+    #: same column (px.substring(s, px.find(s, a)+8, ...)) still compile to
+    #: one LUT over the root dictionary instead of failing.
+    origin: Optional[tuple] = None
 
 
 def apply_lut(lut: jax.Array, codes: jax.Array, fill):
@@ -63,6 +70,10 @@ def apply_lut_np(lut: np.ndarray, codes: np.ndarray, fill=-1) -> np.ndarray:
         return np.full_like(codes, fill)
     out = lut[np.clip(codes, 0, len(lut) - 1)]
     return np.where(codes >= 0, out, fill)
+
+
+#: placeholder for Literal positions when probing composed origins (never read)
+_LIT_SVAL = SVal(DT.INT64, lambda env: None)
 
 
 class ExprCompiler:
@@ -101,7 +112,13 @@ class ExprCompiler:
         ):
             dt = _JNP_DTYPE[target]
             b = v.build
-            return SVal(target, lambda env, b=b, dt=dt: b(env).astype(dt))
+            o = v.origin
+            if o is not None:
+                d0, root, g, cb = o
+                py = float if target == DT.FLOAT64 else int
+                o = (d0, root, lambda x, g=g, py=py: py(g(x)), cb)
+            return SVal(target, lambda env, b=b, dt=dt: b(env).astype(dt),
+                        origin=o)
         raise CompilerError(f"cannot cast {v.dtype.name} to {target.name}")
 
     # ------------------------------------------------------------------ entry
@@ -127,7 +144,10 @@ class ExprCompiler:
         if name not in self.col_dtypes:
             raise CompilerError(f"column {name!r} not found; have {sorted(self.col_dtypes)}")
         dt = self.col_dtypes[name]
-        return SVal(dt, lambda env, name=name: env["cols"][name], self.col_dicts.get(name))
+        build = lambda env, name=name: env["cols"][name]  # noqa: E731
+        d = self.col_dicts.get(name)
+        origin = (d, name, lambda v: v, build) if d is not None else None
+        return SVal(dt, build, d, origin)
 
     def _compile_literal(self, expr: Literal) -> SVal:
         if expr.dtype == DT.STRING:
@@ -179,7 +199,35 @@ class ExprCompiler:
         def build(env, f=f, builders=builders):
             return f(*[b(env) for b in builders])
 
-        return SVal(udf.out_type, build)
+        return SVal(udf.out_type, build,
+                    origin=self._composed_origin(call.args, svals, f))
+
+    @staticmethod
+    def _composed_origin(args, svals, f) -> Optional[tuple]:
+        """Origin of f(args) when every non-literal arg is a per-value
+        function of the SAME dict-encoded root column; None otherwise."""
+        non_lit = [v for a, v in zip(args, svals) if not isinstance(a, Literal)]
+        if not non_lit or any(v.origin is None for v in non_lit):
+            return None
+        d0, root, _, cb = non_lit[0].origin
+        if any(v.origin[0] is not d0 or v.origin[1] != root
+               for v in non_lit[1:]):
+            return None
+
+        def fn(v, f=f, spec=tuple(zip(args, svals))):
+            vals = []
+            for a, sv in spec:
+                if isinstance(a, Literal):
+                    vals.append(a.value)
+                else:
+                    vals.append(sv.origin[2](v))
+            out = f(*vals)
+            # device fns return jax scalars here (eager per-dict-value eval);
+            # normalize to python so downstream host fns see native types
+            return out if isinstance(out, (str, bytes, int, float, bool)) \
+                else np.asarray(out).item()
+
+        return (d0, root, fn, cb)
 
     def _host_call(self, call: Call, udf, arg_types) -> SVal:
         """Host UDF → device LUT.
@@ -203,13 +251,33 @@ class ExprCompiler:
         if not non_lit:
             raise CompilerError(f"{udf.name}: needs one column argument")
         if len(non_lit) != 1:
+            # NOTE: compiling the args may register intermediate LUTs that
+            # the composed-origin LUT then supersedes; they still ship with
+            # the kernel (bounded by the arg dictionaries' sizes).  Accepted
+            # cost — pruning would need a reachability pass over builders.
+            svals = [self.compile(a) if not isinstance(a, Literal) else None
+                     for a in call.args]
+            origin = self._composed_origin(
+                call.args, [s if s is not None else _LIT_SVAL for s in svals],
+                udf.fn)
+            if origin is not None:
+                return self._origin_call(udf, origin)
             raise CompilerError(
                 f"{udf.name}: host UDFs take one column argument "
-                "(or two dictionary-encoded columns); others must be literals"
+                "(or two dictionary-encoded columns, or several values "
+                "derived from ONE dictionary column); others must be literals"
             )
         col_idx = non_lit[0]
         s = self.compile(call.args[col_idx])
         if s.dictionary is None:
+            if s.origin is not None:
+                # non-dict value (e.g. an int from px.find) that is still a
+                # pure function of one dict column: compose over its root
+                origin = self._composed_origin(call.args, [
+                    s if i == col_idx else _LIT_SVAL
+                    for i in range(len(call.args))
+                ], udf.fn)
+                return self._origin_call(udf, origin)
             raise CompilerError(
                 f"{udf.name}: column argument must be dictionary-encoded (STRING/UINT128)"
             )
@@ -222,6 +290,12 @@ class ExprCompiler:
 
         size = s.dictionary.size
         b = s.build
+        # the result is itself a pure per-value function of s's root column
+        origin = None
+        if s.origin is not None:
+            d0, root, g, cb = s.origin
+            origin = (d0, root,
+                      lambda v, g=g, call_fn=call_fn: call_fn(g(v)), cb)
         if udf.out_type == DT.STRING:
             out_dict = Dictionary()
             lut = s.dictionary.lut(lambda v: out_dict.code(call_fn(v)), np.int32, size=size)
@@ -230,6 +304,7 @@ class ExprCompiler:
                 DT.STRING,
                 lambda env, name=name, b=b: apply_lut(env["luts"][name], b(env), -1),
                 out_dict,
+                origin=origin,
             )
         np_out = STORAGE_DTYPE[udf.out_type]
         lut = s.dictionary.lut(call_fn, np_out, size=size)
@@ -238,6 +313,45 @@ class ExprCompiler:
         return SVal(
             udf.out_type,
             lambda env, name=name, b=b, fill=fill: apply_lut(env["luts"][name], b(env), fill),
+            origin=origin,
+        )
+
+    #: compile-time cap on per-dictionary-value composed evaluation (each
+    #: value may run several eager device ops — keep python work bounded)
+    ORIGIN_CAP = 1 << 16
+
+    def _origin_call(self, udf, origin) -> SVal:
+        """Host UDF whose value is a pure per-dict-value function of one root
+        column (origin tuple): evaluate over the root dictionary into a LUT
+        applied to the ROOT column's codes."""
+        root_dict, _root, fn, codes_build = origin
+        size = root_dict.size
+        if size > self.ORIGIN_CAP:
+            raise CompilerError(
+                f"{udf.name}: root dictionary has {size} values, beyond the "
+                f"composed-evaluation cap {self.ORIGIN_CAP}"
+            )
+        if udf.out_type == DT.STRING:
+            out_dict = Dictionary()
+            lut = root_dict.lut(lambda v: out_dict.code(fn(v)), np.int32,
+                                size=size)
+            name = self._add_lut(lut)
+            return SVal(
+                DT.STRING,
+                lambda env, name=name, b=codes_build: apply_lut(
+                    env["luts"][name], b(env), -1),
+                out_dict,
+                origin=origin,
+            )
+        np_out = STORAGE_DTYPE[udf.out_type]
+        lut = root_dict.lut(fn, np_out, size=size)
+        name = self._add_lut(lut)
+        fill = False if udf.out_type == DT.BOOLEAN else 0
+        return SVal(
+            udf.out_type,
+            lambda env, name=name, b=codes_build, fill=fill: apply_lut(
+                env["luts"][name], b(env), fill),
+            origin=origin,
         )
 
     #: cross-product bound for two-dictionary host calls (compile-time python

@@ -118,6 +118,16 @@ def _gen_column(name: str, dt: DT, n: int, rng, t0: int, t1: int, upids, ips):
         return rng.exponential(10.0, n)
     if name in ("remote_port",):
         return rng.integers(1024, 60000, n).astype(np.int64)
+    if name == "src_ip":
+        return [ips[i] for i in rng.integers(0, len(ips), n)]
+    if name == "dst_ip":
+        # pod ips + service cluster ips (10.96.0.x, see demo_metadata) so
+        # nslookup/ip_to_pod_id in the tcp_* scripts both resolve
+        pool = ips + [f"10.96.0.{i + 1}" for i in range(len(_SERVICES))]
+        return [pool[i] for i in rng.integers(0, len(pool), n)]
+    if name == "state":
+        pool = ["ESTABLISHED", "CLOSE_WAIT", "SYN_SENT"]
+        return [pool[i] for i in rng.integers(0, len(pool), n)]
     if name == "trace_role":
         return rng.integers(1, 3, n).astype(np.int64)  # requestor/responder
     if name == "req_op" or (name == "req_cmd" and dt == DT.INT64):

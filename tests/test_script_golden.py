@@ -1,0 +1,515 @@
+"""Golden-VALUE execution parity for bundled reference scripts.
+
+Each oracle reimplements a bundled PxL script's semantics independently in
+pandas/numpy over the same demo store + metadata snapshot, then compares the
+engine's output values row-for-row.  This is the reference CarnotTest golden
+pattern (src/carnot/carnot_test.cc:43) applied at script level — compile
+parity (test_all_scripts) and non-crash execution (test_script_execution)
+cannot catch wrong answers; these can.
+
+Approximate quantities (px.quantiles = log-histogram sketch, gamma=1.02) are
+compared with a relative tolerance; everything else must match exactly.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu.collect.schemas import all_schemas
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.metadata.state import global_manager, set_global_manager
+from pixie_tpu.testing import build_demo_store, demo_metadata
+
+SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
+SEC = 1_000_000_000
+NOW = 600 * SEC
+#: below every script's head() default (1000 / 100 with a narrower window), so
+#: head() never truncates and order-insensitive comparison is sound
+ROWS = 800
+
+_STATE = {}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def demo_cluster():
+    old = global_manager()
+    mgr, _upids, _ips = demo_metadata()
+    set_global_manager(mgr)
+    store = build_demo_store(rows=ROWS, now_ns=NOW)
+    _STATE["snap"] = mgr.current()
+    _STATE["store"] = store
+    yield store
+    set_global_manager(old)
+    _STATE.clear()
+
+
+# ------------------------------------------------------------------- helpers
+
+
+def tdf(name: str) -> pd.DataFrame:
+    """Decoded pandas frame of a demo table."""
+    t = _STATE["store"].table(name)
+    frames = []
+    for rb, _, _ in t.cursor():
+        d = {}
+        for c in t.relation:
+            arr = rb.columns[c.name][: rb.num_valid]
+            if c.name in t.dictionaries:
+                d[c.name] = t.dictionaries[c.name].decode(arr)
+            else:
+                d[c.name] = arr
+        frames.append(pd.DataFrame(d))
+    return pd.concat(frames, ignore_index=True)
+
+
+def run_script(name: str, func=None, args=None):
+    """Compile + execute one bundled script (or one of its vis funcs)."""
+    import tests.test_all_scripts as harness
+
+    d = SCRIPTS / name
+    source = harness._source_of(d)
+    q = compile_pxl(source, all_schemas(), func=func, func_args=args, now=NOW)
+    return execute_plan(q.plan, _STATE["store"]), q
+
+
+def run_default_func(name: str, overrides=None):
+    """Run the script's first vis func with its default args (the UI path)."""
+    import tests.test_all_scripts as harness
+
+    d = SCRIPTS / name
+    vis = json.loads((d / "vis.json").read_text())
+    funcs = harness._funcs_to_compile(vis)
+    fname, fargs = funcs[0] if funcs else (None, None)
+    if overrides and fargs is not None:
+        fargs = {**fargs, **overrides}
+    return run_script(name, func=fname, args=fargs)
+
+
+def one_result(results) -> object:
+    assert len(results) == 1, sorted(results)
+    return next(iter(results.values()))
+
+
+# metadata value maps (ground truth = the SAME snapshot the engine reads; the
+# oracle independently recomputes the relational algebra, which is what these
+# golden tests gate)
+def q_pod(u):
+    p = _STATE["snap"].pod_of_upid(u)
+    return p.qualified_name if p else ""
+
+
+def q_ns(u):
+    p = _STATE["snap"].pod_of_upid(u)
+    return p.namespace if p else ""
+
+
+def q_svc(u):
+    s = _STATE["snap"].service_of_upid(u)
+    return s.qualified_name if s else ""
+
+
+def q_node(u):
+    p = _STATE["snap"].pod_of_upid(u)
+    return p.node if p else ""
+
+
+def q_cmdline(u):
+    return _STATE["snap"].upid_to_cmdline.get(u, "")
+
+
+def ip_pod(ip: str) -> str:
+    p = _STATE["snap"].pod_of_ip(ip)
+    return p.qualified_name if p else ""
+
+
+def nslookup(ip: str) -> str:
+    return _STATE["snap"].nslookup(ip)
+
+
+def add_src_dst(df: pd.DataFrame) -> pd.DataFrame:
+    """The shared add_source_dest_columns() logic of every *_data script
+    (e.g. px/http_data/data.pxl add_source_dest_columns)."""
+    df = df.copy()
+    df["pod"] = df["upid"].map(q_pod)
+    ra_pod = df["remote_addr"].map(ip_pod)
+    is_ra_pod = ra_pod != ""
+    ra_name = np.where(is_ra_pod, ra_pod, df["remote_addr"])
+    server = df["trace_role"] == 2
+    df["source"] = np.where(server, ra_name, df["pod"])
+    df["destination"] = np.where(server, df["pod"], ra_name)
+    return df[(df["source"] != "") & (df["destination"] != "")]
+
+
+def assert_frames(res, exp: pd.DataFrame, approx=(), rtol=1e-9):
+    """Order-insensitive value comparison of a QueryResult vs a pandas frame."""
+    got = res.to_pandas()
+    assert set(got.columns) == set(exp.columns), (
+        sorted(got.columns), sorted(exp.columns))
+    exp = exp[list(got.columns)].reset_index(drop=True)
+    assert len(got) == len(exp), f"rows {len(got)} != {len(exp)}"
+    keys = [c for c in got.columns if c not in approx]
+
+    def order(df):
+        if not keys:
+            return df.reset_index(drop=True)
+        k = np.lexsort([df[c].astype(str).to_numpy() for c in reversed(keys)])
+        return df.iloc[k].reset_index(drop=True)
+
+    gs, es = order(got), order(exp)
+    for c in got.columns:
+        if c in approx:
+            np.testing.assert_allclose(
+                gs[c].to_numpy(dtype=float), es[c].to_numpy(dtype=float),
+                rtol=rtol, err_msg=c)
+        else:
+            assert gs[c].tolist() == es[c].tolist(), c
+
+
+def since(df: pd.DataFrame, rel_s: int) -> pd.DataFrame:
+    return df[df["time_"] >= NOW - rel_s * SEC]
+
+
+# ------------------------------------------------- *_data tracer scripts (7)
+
+
+def _data_script_oracle(table: str, window_s: int = 300) -> pd.DataFrame:
+    return add_src_dst(since(tdf(table), window_s))
+
+
+class TestDataScripts:
+    def test_http_data(self):
+        results, q = run_default_func("http_data")
+        res = one_result(results)
+        exp = _data_script_oracle("http_events")
+        exp["major_version"] = exp["major_version"]
+        exp = exp[["time_", "source", "destination", "latency", "major_version",
+                   "req_path", "req_method", "req_headers", "req_body",
+                   "req_body_size", "resp_status", "resp_message",
+                   "resp_headers", "resp_body", "resp_body_size"]]
+        assert_frames(res, exp)
+
+    def test_mysql_data(self):
+        res = one_result(run_default_func("mysql_data")[0])
+        exp = _data_script_oracle("mysql_events")
+        exp = exp[["time_", "source", "destination", "remote_port", "req_cmd",
+                   "req_body", "resp_status", "resp_body", "latency"]]
+        assert_frames(res, exp)
+
+    def test_pgsql_data(self):
+        res = one_result(run_default_func("pgsql_data")[0])
+        exp = _data_script_oracle("pgsql_events")
+        exp = exp[["time_", "source", "destination", "remote_port", "req",
+                   "resp", "latency"]]
+        assert_frames(res, exp)
+
+    def test_redis_data(self):
+        res = one_result(run_default_func("redis_data")[0])
+        exp = _data_script_oracle("redis_events")
+        exp = exp[["time_", "source", "destination", "remote_port", "req_cmd",
+                   "req_args", "resp", "latency"]]
+        assert_frames(res, exp)
+
+    def test_dns_data(self):
+        res = one_result(run_default_func("dns_data")[0])
+        exp = _data_script_oracle("dns_events")
+        exp = exp[["time_", "source", "destination", "latency", "req_header",
+                   "req_body", "resp_header", "resp_body"]]
+        assert_frames(res, exp)
+
+    def test_cql_data(self):
+        res = one_result(run_default_func("cql_data")[0])
+        exp = _data_script_oracle("cql_events")
+        exp = exp[["time_", "source", "destination", "latency", "req_op",
+                   "req_body", "resp_op", "resp_body"]]
+        assert_frames(res, exp)
+
+    def test_kafka_data(self):
+        from pixie_tpu.udf.builtins import _kafka_api_key_name
+
+        res = one_result(run_default_func("kafka_data")[0])
+        exp = _data_script_oracle("kafka_events.beta")
+        exp["req_cmd"] = exp["req_cmd"].map(_kafka_api_key_name)
+        exp = exp[["time_", "source", "destination", "remote_port", "req_cmd",
+                   "req_body", "resp", "latency"]]
+        assert_frames(res, exp)
+
+    def test_nats_data(self):
+        res = one_result(run_default_func("nats_data")[0])
+        exp = _data_script_oracle("nats_events.beta")
+        exp["pid"] = exp["upid"].map(lambda u: u.pid)
+        exp = exp[["time_", "source", "destination", "cmd", "body", "resp",
+                   "pid"]]
+        assert_frames(res, exp)
+
+
+# ------------------------------------------------------ http drill-down (5)
+
+
+class TestHttpScripts:
+    def test_http_post_requests(self):
+        res = one_result(run_script("http_post_requests")[0])
+        df = since(tdf("http_events"), 30)
+        df = df[df["req_method"] == "POST"].copy()
+        df["service"] = df["upid"].map(q_svc)
+        exp = df[["time_", "remote_addr", "remote_port", "req_method",
+                  "req_path", "resp_status", "resp_body", "latency",
+                  "service"]]
+        assert_frames(res, exp)
+
+    def test_http_data_filtered(self):
+        res = one_result(run_default_func(
+            "http_data_filtered",
+            overrides={"start_time": "-30s", "svc": "", "pod": "",
+                       "req_path": "", "status_code": 200})[0])
+        df = since(tdf("http_events"), 30)
+        df = df[df["resp_status"] == 200].copy()
+        df["svc"] = df["upid"].map(q_svc)
+        df["pod"] = df["upid"].map(q_pod)
+        exp = df[["time_", "remote_addr", "remote_port", "req_method",
+                  "req_path", "resp_status", "resp_body", "latency", "svc",
+                  "pod"]]
+        assert_frames(res, exp)
+
+    def test_most_http_data(self):
+        res = one_result(run_script("most_http_data")[0])
+        df = since(tdf("http_events"), 120).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[(df["req_path"] != "/healthz") & (df["req_path"] != "/readyz")
+                & (df["remote_addr"] != "-")]
+        g = (df.groupby(["pod", "req_path"], as_index=False)
+               .agg(resp_bytes_sum=("resp_body_size", "sum")))
+        exp = g[g["resp_bytes_sum"] == g["resp_bytes_sum"].max()]
+        assert_frames(res, exp)
+
+    def test_largest_http_request(self):
+        results, q = run_script("largest_http_request")
+        df = since(tdf("http_events"), 120).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[(df["req_path"] != "/healthz") & (df["req_path"] != "/readyz")
+                & (df["remote_addr"] != "-")]
+        mx = df["resp_body_size"].max()
+        top = df[df["resp_body_size"] == mx].copy()
+        top = top.rename(columns={"resp_body_size": "resp_size_bytes"})
+        exp1 = top[["pod", "resp_size_bytes", "resp_body", "req_path"]]
+        assert_frames(results["requests_of_max_size"], exp1)
+        exp2 = (top.groupby(["pod", "req_path", "resp_size_bytes"],
+                            as_index=False)
+                .agg(num_requests=("resp_size_bytes", "count")))
+        assert_frames(results["number of reqs"], exp2)
+
+    def test_http_request_stats(self):
+        res = one_result(run_script("http_request_stats")[0])
+        df = since(tdf("http_events"), 30).copy()
+        df["service"] = df["upid"].map(q_svc)
+        df["failure"] = df["resp_status"] >= 400
+        window = 5 * SEC
+        df["range_group"] = (df["time_"] // window) * window
+        qa = df.groupby("service").agg(
+            errors=("failure", "mean"),
+            throughput_total=("resp_status", "count"),
+        )
+        lat = df.groupby("service")["latency"]
+        qa["latency(p50)"] = lat.quantile(0.5)
+        qa["latency(p90)"] = lat.quantile(0.9)
+        qa["latency(p99)"] = lat.quantile(0.99)
+        rng = (df.groupby(["service", "range_group"])
+               .agg(rpw=("resp_status", "count")).reset_index())
+        rps = rng.groupby("service").agg(request_throughput=("rpw", "mean"))
+        exp = qa.join(rps).reset_index()
+        exp["throughput"] = exp["request_throughput"] / window
+        exp["throughput total"] = exp["throughput_total"]
+        exp = exp[exp["service"] != ""]
+        exp = exp[["service", "latency(p50)", "latency(p90)", "latency(p99)",
+                   "errors", "throughput", "throughput total"]]
+        # quantiles come from a log-histogram sketch (gamma=1.02): compare
+        # with a generous relative tolerance; exact pandas quantile
+        # interpolation also differs from sketch semantics at small N
+        assert_frames(
+            res, exp,
+            approx=("latency(p50)", "latency(p90)", "latency(p99)", "errors",
+                    "throughput"),
+            rtol=0.12,
+        )
+
+
+# ------------------------------------------------------- conn_stats (3)
+
+
+class TestConnScripts:
+    def _counters(self, df, trace_role):
+        df = df[df["trace_role"] == trace_role].copy()
+        df["pod"] = df["upid"].map(q_pod)
+        return df
+
+    def test_net_flow_graph(self):
+        res = one_result(run_default_func(
+            "net_flow_graph",
+            overrides={"ns": "default", "throughput_filter": 0.0})[0])
+        df = since(tdf("conn_stats"), 300).copy()
+        df["namespace"] = df["upid"].map(q_ns)
+        df = df[df["namespace"] == "default"]
+        df = self._counters(df, 1)
+        df = df[df["pod"] != ""]
+        tmin, tmax = df["time_"].min(), df["time_"].max()
+        g = (df.groupby(["pod", "upid", "remote_addr"], as_index=False)
+             .agg(bs_min=("bytes_sent", "min"), bs_max=("bytes_sent", "max"),
+                  br_min=("bytes_recv", "min"), br_max=("bytes_recv", "max")))
+        g["bytes_sent"] = g["bs_max"] - g["bs_min"]
+        g["bytes_recv"] = g["br_max"] - g["br_min"]
+        g["bytes_total"] = g["bytes_sent"] + g["bytes_recv"]
+        g["from_entity"] = g["pod"]
+        g["to_entity"] = g["remote_addr"].map(nslookup)
+        out = (g.groupby(["from_entity", "to_entity"], as_index=False)
+               .agg(bytes_sent=("bytes_sent", "sum"),
+                    bytes_recv=("bytes_recv", "sum"),
+                    bytes_total=("bytes_total", "sum")))
+        delta = int(tmax - tmin)
+        for c in ("bytes_sent", "bytes_recv", "bytes_total"):
+            out[c] = out[c] / delta
+        out = out[out["bytes_total"] > 0]
+        assert_frames(res, out,
+                      approx=("bytes_sent", "bytes_recv", "bytes_total"))
+
+    def test_inbound_conns(self):
+        res = one_result(run_default_func("inbound_conns")[0])
+        df = since(tdf("conn_stats"), 300)
+        df = self._counters(df, 2)
+        remote_pod = df["remote_addr"].map(
+            lambda ip: _STATE["snap"].ip_to_pod_uid.get(ip, ""))
+        remote_svc = df["remote_addr"].map(
+            lambda ip: _STATE["snap"].ip_to_service_uid.get(ip, ""))
+        df = df[(remote_pod == "") & (remote_svc == "")]
+        df = df[df["remote_addr"] != "127.0.0.1"]
+        g = (df.groupby(["pod", "upid", "remote_addr"], as_index=False)
+             .agg(co_min=("conn_open", "min"), co_max=("conn_open", "max"),
+                  bs_min=("bytes_sent", "min"), bs_max=("bytes_sent", "max"),
+                  br_min=("bytes_recv", "min"), br_max=("bytes_recv", "max"),
+                  last_activity_time=("time_", "max")))
+        g["conn_open"] = g["co_max"] - g["co_min"]
+        g["bytes_sent"] = g["bs_max"] - g["bs_min"]
+        g["bytes_recv"] = g["br_max"] - g["br_min"]
+        out = (g.groupby(["pod", "remote_addr"], as_index=False)
+               .agg(conn_open=("conn_open", "sum"),
+                    bytes_sent=("bytes_sent", "sum"),
+                    bytes_recv=("bytes_recv", "sum"),
+                    last_activity_time=("last_activity_time", "max")))
+        assert_frames(res, out)
+
+
+# ------------------------------------------------------------ process (3)
+
+
+class TestProcessScripts:
+    def test_pid_memory_usage(self):
+        res = one_result(run_script("pid_memory_usage")[0])
+        df = since(tdf("process_stats"), 30).copy()
+        df["timestamp"] = (df["time_"] // (10 * SEC)) * (10 * SEC)
+        df["cmdline"] = df["upid"].map(q_cmdline)
+        g = (df.groupby(["upid", "timestamp", "cmdline"], as_index=False)
+             .agg(vsize=("vsize_bytes", "mean"), rss=("rss_bytes", "mean")))
+        g["pid"] = g["upid"].map(lambda u: u.pid)
+        g["asid"] = g["upid"].map(lambda u: u.asid)
+        g["Process Name"] = g["cmdline"]
+        g["Virtual Memory"] = g["vsize"]
+        g["Average Memory"] = g["rss"]
+        exp = g[["pid", "Process Name", "asid", "timestamp", "Virtual Memory",
+                 "Average Memory"]]
+        assert_frames(res, exp,
+                      approx=("Virtual Memory", "Average Memory"))
+
+    def test_pod_memory_usage(self):
+        res = one_result(run_script("pod_memory_usage")[0])
+        df = since(tdf("process_stats"), 60).copy()
+        df["timestamp"] = (df["time_"] // (10 * SEC)) * (10 * SEC)
+        df["pod"] = df["upid"].map(q_pod)
+        g = (df.groupby(["upid", "pod", "timestamp"], as_index=False)
+             .agg(vsize=("vsize_bytes", "mean"), rss=("rss_bytes", "mean")))
+        out = (g.groupby(["pod", "timestamp"], as_index=False)
+               .agg(vsize=("vsize", "sum"), rss=("rss", "sum")))
+        out["Virtual Memory"] = out["vsize"]
+        out["Average Memory"] = out["rss"]
+        exp = out[["pod", "timestamp", "Virtual Memory", "Average Memory"]]
+        assert_frames(res, exp,
+                      approx=("Virtual Memory", "Average Memory"))
+
+    def test_jvm_data(self):
+        res = one_result(run_script("jvm_data")[0])
+        df = since(tdf("jvm_stats"), 60).copy()
+        df["pid"] = df["upid"].map(lambda u: u.pid)
+        df["cmdline"] = df["upid"].map(q_cmdline)
+        exp = df[["time_", "pid", "used_heap_size", "total_heap_size",
+                  "max_heap_size", "cmdline"]]
+        assert_frames(res, exp)
+
+
+# -------------------------------------------------------- simple + tcp (4)
+
+
+class TestSimpleScripts:
+    def test_network_stats(self):
+        res = one_result(run_script("network_stats")[0])
+        df = since(tdf("network_stats"), 30)
+        exp = df[["time_", "pod_id", "rx_bytes", "rx_packets", "rx_errors",
+                  "rx_drops", "tx_bytes", "tx_packets", "tx_errors",
+                  "tx_drops"]]
+        assert_frames(res, exp)
+
+    def _tcp_oracle(self, table, out_col):
+        df = tdf(table).copy()
+        pod_uid = df["src_ip"].map(
+            lambda ip: _STATE["snap"].ip_to_pod_uid.get(ip, ""))
+        df["src"] = pod_uid.map(
+            lambda uid: _STATE["snap"].pods_by_uid[uid].qualified_name
+            if uid else "")
+        df["dst"] = df["dst_ip"].map(nslookup)
+        g = (df.groupby(["src", "dst"], as_index=False)
+             .agg(**{out_col: ("src", "count")}))
+        return g[g[out_col] > 0]
+
+    def test_tcp_drops(self):
+        results, q = run_default_func("tcp_drops")
+        res = one_result(results)
+        assert_frames(res, self._tcp_oracle("tcp_drop_table", "drops"))
+
+    def test_tcp_retransmits(self):
+        results, q = run_default_func("tcp_retransmits")
+        res = one_result(results)
+        assert_frames(
+            res, self._tcp_oracle("tcp_retransmissions", "retransmissions"))
+
+
+# ------------------------------------------------------------ dns graph (1)
+
+
+class TestDnsFlowGraph:
+    def test_dns_flow_graph(self):
+        results, q = run_default_func("dns_flow_graph")
+        # two sinks: the drawer debug table + the graph; pick the graph (has
+        # from_entity/to_entity)
+        res = next(r for r in results.values()
+                   if "from_entity" in r.relation.names())
+        df = since(tdf("dns_events"), 300)
+        df = df[df["trace_role"] == 1].copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[~df["pod"].str.contains("pl")]
+        df = df[df["pod"] != ""]
+        df = df[df["remote_addr"] != "-"]
+        df["from_entity"] = df["pod"]
+        df["to_entity"] = df["remote_addr"].map(nslookup)
+        idx = df["to_entity"].str.find(".svc.cluster")
+        df["to_entity"] = np.where(
+            idx >= 0,
+            [s[:i] if i >= 0 else s
+             for s, i in zip(df["to_entity"], idx)],
+            df["to_entity"],
+        )
+        exp = (df.groupby(["from_entity", "to_entity"], as_index=False)
+               .agg(latency_avg=("latency", "mean"),
+                    latency_max=("latency", "max"),
+                    count=("latency", "count")))
+        assert_frames(res, exp, approx=("latency_avg",))
