@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -494,7 +495,74 @@ def main():
             ),
         },
     }
+    regressions = _regression_check(result)
+    if regressions:
+        result["regressions_vs_prior_round"] = regressions
+        print(
+            "BENCH REGRESSION (>20% drop vs prior round): "
+            + "; ".join(
+                f"{r['key']}: {r['prior']} -> {r['now']} rows/s "
+                f"({r['drop_pct']}%)" for r in regressions
+            ),
+            file=sys.stderr,
+        )
     print(json.dumps(result))
+
+
+def _regression_check(result, threshold=0.20):
+    """Compare per-config rows/sec against the newest BENCH_r*.json.
+
+    Round 3 shipped a 43% silent regression in config #4; every bench run now
+    self-audits.  Returns a list of {key, prior, now, drop_pct} entries for
+    any config/sweep point that dropped more than `threshold`."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    prior = None
+    best_round = -1
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        rnd = int(m.group(1))
+        if rnd <= best_round:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            parsed = doc.get("parsed", doc)
+            if isinstance(parsed, dict) and "configs" in parsed:
+                prior, best_round = parsed, rnd
+        except Exception:
+            continue
+    if prior is None:
+        return []
+
+    def points(doc):
+        """{key: (rows_per_sec, shape_rows)} — only shape-matched points
+        compare (a --smoke/--quick run must not 'regress' vs a full run)."""
+        out = {}
+        top_rows = doc.get("rows")
+        for k, v in (doc.get("configs") or {}).items():
+            if isinstance(v, dict) and "rows_per_sec" in v:
+                out[f"configs.{k}"] = (v["rows_per_sec"], v.get("rows", top_rows))
+        for k, v in (doc.get("sweep") or {}).items():
+            if isinstance(v, dict) and "rows_per_sec" in v:
+                out[f"sweep.{k}"] = (v["rows_per_sec"], int(k))
+        return out
+
+    old, new = points(prior), points(result)
+    regs = []
+    for k, (prev, prev_rows) in old.items():
+        now, now_rows = new.get(k, (None, None))
+        if now is None or not prev or prev_rows != now_rows:
+            continue
+        drop = (prev - now) / prev
+        if drop > threshold:
+            regs.append({"key": k, "prior": prev, "now": now,
+                         "drop_pct": round(drop * 100, 1)})
+    return regs
 
 
 if __name__ == "__main__":

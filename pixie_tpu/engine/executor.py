@@ -25,7 +25,7 @@ from __future__ import annotations
 import contextlib as _contextlib
 import dataclasses
 import time as _time
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -287,20 +287,26 @@ def _src_rows(src) -> Optional[int]:
         return None
 
 
-def _route_backend(src) -> str:
+def _route_backend(src, scale: int = 1) -> str:
+    """Backend for this input.  `scale` is the distributed fan-out (number of
+    data agents executing the same fragment): routing must consider the
+    QUERY's size, not the local shard's — 8 agents each holding 2M rows are a
+    16M-row query, and pushing each shard to XLA-CPU throws away the TPU win
+    that partial aggregation exists to deliver (round-3 config-4 regression).
+    """
     n = _src_rows(src)
-    if n is not None and n <= CPU_CROSSOVER_ROWS and \
+    if n is not None and n * max(1, scale) <= CPU_CROSSOVER_ROWS and \
             _cpu_device() is not False:
         return "cpu"
     return "tpu"
 
 
-def _small_input_device(src):
+def _small_input_device(src, scale: int = 1):
     """Context manager routing kernel dispatch to CPU below the crossover.
     Only uncommitted (numpy) inputs follow the default device, so TPU-cached
     feeds keep their placement — the context is a preference, not a forced
     transfer."""
-    if _route_backend(src) == "cpu":
+    if _route_backend(src, scale) == "cpu":
         return jax.default_device(_cpu_device())
     return _contextlib.nullcontext()
 
@@ -607,10 +613,11 @@ class ChainKernel:
         return jax.jit(step)
 
     @staticmethod
-    def make_merge_states(udas):
-        """→ jit fn(*states) → merged state, as ONE stacked reduction per leaf
-        (flat dependency graph: N partials merge in a single execution)."""
-        reduce_tree = {name: uda.reduce_ops() for name, uda, _vb in udas}
+    def merge_states_fn(reduce_tree):
+        """Traceable fn(*states) → merged state: ONE stacked reduction per
+        leaf, op per leaf from `reduce_tree` ("add"|"min"|"max").  The single
+        source of truth for device-side state merging (per-feed AND the
+        cross-agent gang merge)."""
         fns = {"add": (lambda s: jnp.sum(s, axis=0)),
                "min": (lambda s: jnp.min(s, axis=0)),
                "max": (lambda s: jnp.max(s, axis=0))}
@@ -623,7 +630,14 @@ class ChainKernel:
                 is_leaf=lambda x: isinstance(x, str),
             )
 
-        return jax.jit(merge)
+        return merge
+
+    @staticmethod
+    def make_merge_states(udas):
+        """→ jit fn(*states) → merged state (flat dependency graph: N
+        partials merge in a single execution)."""
+        reduce_tree = {name: uda.reduce_ops() for name, uda, _vb in udas}
+        return jax.jit(ChainKernel.merge_states_fn(reduce_tree))
 
     @staticmethod
     def make_merge_states_np(udas):
@@ -782,10 +796,89 @@ def _chain_required_columns(chain, needed: set):
 # -------------------------------------------------------------------- executor
 
 
+def _state_on_cpu(state) -> bool:
+    """True if every leaf of a partial state lives on host/CPU."""
+    for leaf in jax.tree.leaves(state):
+        if isinstance(leaf, np.ndarray):
+            continue
+        if isinstance(leaf, jax.Array):
+            try:
+                if any(d.platform != "cpu" for d in leaf.devices()):
+                    return False
+            except Exception:
+                return False
+        else:
+            return False
+    return True
+
+
+@dataclasses.dataclass
+class _DeferredState:
+    """Un-pulled partial-agg state: per-feed device partials + the host merge
+    to run after the (batched) readback."""
+
+    partials: list
+    merge_fn: Callable
+    #: pre-merged state of any CPU-resident feeds (hot remainder), merged on
+    #: host at defer time; folded in at finish
+    host_state: object = None
+
+
+@dataclasses.dataclass
+class _DeferredPartial:
+    """An agg_state channel payload whose readback is deferred: the cluster
+    pulls `partials` (for ALL agents in one transfer wave) and then calls
+    finish(pulled) -> PartialAggBatch.
+
+    When every agent's `layout_fp` matches (same group-key value sets /
+    dictionaries / UDA layout), the cluster instead merges ALL agents' states
+    ON DEVICE (gang_merge_states) and finishes once on the merged state —
+    the TPU-native tree reduction of SURVEY §2.5 P2, and 8x fewer readback
+    bytes on a slow tunnel."""
+
+    partials: list
+    finish: Callable
+    #: state-layout fingerprint; None = never gang-merge (e.g. sorted path)
+    layout_fp: object = None
+    #: finish on an ALREADY-MERGED state_np (gang path)
+    finish_state: Optional[Callable] = None
+    #: {out_name: reduce-op pytree} for the device merge
+    reduce_tree: object = None
+    #: CPU-feed state merged at defer time (not part of `partials`)
+    host_state: object = None
+    #: host merge fn for folding host_state into a pulled/merged state
+    host_merge: Optional[Callable] = None
+
+
+#: jitted cross-agent state merges, keyed by (layout_fp, arity) — a fresh
+#: jit per query would recompile the merge every time
+_GANG_MERGE_CACHE: dict = {}
+
+
+def gang_merge_states(deferred: list) -> object:
+    """Merge every agent's per-feed device partials into ONE device state.
+    Caller guarantees equal layout_fp across `deferred`."""
+    flat: list = []
+    for d in deferred:
+        flat.extend(d.partials)
+    if len(flat) == 1:
+        return flat[0]
+    key = (deferred[0].layout_fp, len(flat))
+    fn = _GANG_MERGE_CACHE.get(key)
+    if fn is None:
+        # same stacked reduction as ChainKernel.make_merge_states, built from
+        # the payload's reduce_tree (the kernel's udas aren't in scope here)
+        fn = jax.jit(ChainKernel.merge_states_fn(deferred[0].reduce_tree))
+        if len(_GANG_MERGE_CACHE) > 64:
+            _GANG_MERGE_CACHE.clear()
+        _GANG_MERGE_CACHE[key] = fn
+    return fn(*flat)
+
+
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
                  mesh="auto", analyze: bool = False, udtf_ctx=None,
-                 otel_exporter=None):
+                 otel_exporter=None, route_scale: int = 1):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -810,6 +903,17 @@ class PlanExecutor:
         #: override transport for OTel export sinks (tests inject a collector;
         #: None resolves from each sink's endpoint config).
         self.otel_exporter = otel_exporter
+        #: distributed fan-out: how many data agents run this same fragment.
+        #: CPU/TPU routing multiplies local input sizes by this so a sharded
+        #: query routes by its TOTAL size (see _route_backend).
+        self.route_scale = max(1, int(route_scale))
+        #: colocated-agent mode (LocalCluster): partial-agg channels return
+        #: device-resident state (_DeferredPartial) instead of pulling — the
+        #: cluster coalesces ALL agents' readbacks into ONE transfer wave.
+        #: On a remote/tunneled device each sync readback pays a fixed RTT,
+        #: so 8 agents pulling separately cost ~8 waves (measured: 430 ms vs
+        #: 160 ms single-store for the same total rows).
+        self.defer_agg_pull = False
         # Device mesh for SPMD aggregation: every unlimited agg shards its
         # feeds over all local devices and merges state with in-program
         # collectives (the reference's per-PEM fan-out + Kelvin merge becomes
@@ -1156,13 +1260,15 @@ class PlanExecutor:
             # exactly two round-trips — one packed pull of the row counts, one
             # packed pull of the count-sliced outputs.  With a remote TPU each
             # readback costs a fixed RTT, so per-feed pulls would dominate.
-            with self._timed(label, op_ids) as rec, _small_input_device(src):
+            with self._timed(label, op_ids) as rec, \
+                    _small_input_device(src, self.route_scale):
                 has_limit = kern.has_limit
                 remaining = kern.init_limits()
                 feeds = []
                 feed_ns = []
-                for cols, n_valid in self._feed(src, names, cap,
-                                                backend=_route_backend(src)):
+                for cols, n_valid in self._feed(
+                        src, names, cap,
+                        backend=_route_backend(src, self.route_scale)):
                     tf0 = _time.perf_counter_ns()
                     outs, cnt, consumed = step(
                         cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
@@ -1431,7 +1537,7 @@ class PlanExecutor:
             upd = jax.jit(upd, donate_argnums=(0,))
             _cache_put(_json.dumps(upd_key), (upd, udas))
         with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]), \
-                _small_input_device(hb):
+                _small_input_device(hb, self.route_scale):
             # state init happens inside the device context so the donated
             # accumulators live on the dispatch device (CPU for small batches)
             state = {name: uda.init(Gb, in_dt)
@@ -1619,8 +1725,8 @@ class PlanExecutor:
             )
         # Small host-batch inputs dispatch on the CPU backend (compile is the
         # dominant cost at this scale); the SPMD path stays on the mesh.
-        dev_ctx = (_small_input_device(src) if spmd_step is None
-                   else _contextlib.nullcontext())
+        dev_ctx = (_small_input_device(src, self.route_scale)
+                   if spmd_step is None else _contextlib.nullcontext())
         with dev_ctx:
             t_lo, t_hi = _time_bounds(head)
             luts = {**kern.luts, **lut_over} if lut_over else kern.luts
@@ -1762,8 +1868,9 @@ class PlanExecutor:
             state = {name: uda.init(num_groups, in_dt)
                      for name, uda, in_dt in init_specs}
             remaining = kern.init_limits()
-            for cols, n_valid in self._feed(src, names, cap,
-                                            backend=_route_backend(src)):
+            for cols, n_valid in self._feed(
+                    src, names, cap,
+                    backend=_route_backend(src, self.route_scale)):
                 state, cnt, consumed = step(
                     cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
                 )
@@ -1781,7 +1888,8 @@ class PlanExecutor:
             # PEM-partial → Kelvin-finalize, but over ICI).
             partials = []
             n_dev = self.mesh.size if self.mesh is not None else 1
-            backend = "tpu" if spmd_step is not None else _route_backend(src)
+            backend = ("tpu" if spmd_step is not None
+                       else _route_backend(src, self.route_scale))
             for cols, n_valid in self._feed(src, names, cap,
                                             spmd=spmd_step is not None,
                                             backend=backend):
@@ -1811,6 +1919,24 @@ class PlanExecutor:
                 if self.analyze:
                     jax.block_until_ready(partials[-1])
             if partials:
+                # deferral is scoped to the distributed partial path
+                # (_partial_agg_batch) — the local finalize path reads the
+                # pulled state dict directly and must never see a
+                # _DeferredState
+                if getattr(self, "_defer_active", False):
+                    # Split CPU-resident partials (small numpy feeds, e.g.
+                    # the hot remainder) from accelerator ones: CPU states
+                    # merge on host for free, and must NOT ride into the
+                    # device gang merge — that would UPLOAD each one back to
+                    # the accelerator.
+                    dev, host = [], []
+                    for p in partials:
+                        (host if _state_on_cpu(p) else dev).append(p)
+                    host_state = (merge_fn(*transfer.pull(host))
+                                  if host else None)
+                    if not dev:
+                        return host_state
+                    return _DeferredState(dev, merge_fn, host_state)
                 return merge_fn(*transfer.pull(partials))
 
         if state is None:  # no feeds at all: identity state
@@ -1830,17 +1956,67 @@ class PlanExecutor:
     def _partial_agg_batch(self, op: AggOp):
         """Distributed partial path: seen groups as VALUES + raw UDA state
         (see pixie_tpu.parallel.partial.PartialAggBatch)."""
-        from pixie_tpu.parallel.partial import PartialAggBatch
-
+        self._defer_active = self.defer_agg_pull
         try:
             keys, udas, state_np, seen_name, in_types, val_dicts = self._agg_state(op)
         except GroupKeyFallback:
             return self._sorted_partial_batch(op)
+        finally:
+            self._defer_active = False
         if val_dicts:
             raise Internal(
                 "dict-valued aggregates must ship rows, not partial state "
                 "(the distributed planner cuts them as rows channels)"
             )
+        if isinstance(state_np, _DeferredState):
+            deferred = state_np
+
+            def finish_state(merged, self=self, keys=keys, udas=udas,
+                             seen_name=seen_name, in_types=in_types):
+                return self._finish_partial_batch(
+                    keys, udas, merged, seen_name, in_types)
+
+            def finish(pulled, finish_state=finish_state, deferred=deferred):
+                states = list(pulled)
+                if deferred.host_state is not None:
+                    states.append(deferred.host_state)
+                return finish_state(deferred.merge_fn(*states))
+
+            return _DeferredPartial(
+                deferred.partials, finish,
+                layout_fp=self._partial_layout_fp(keys, udas, in_types,
+                                                  seen_name),
+                finish_state=finish_state,
+                reduce_tree={name: uda.reduce_ops()
+                             for name, uda, _vb in udas},
+                host_state=deferred.host_state,
+                host_merge=deferred.merge_fn,
+            )
+        return self._finish_partial_batch(keys, udas, state_np, seen_name,
+                                          in_types)
+
+    @staticmethod
+    def _partial_layout_fp(keys, udas, in_types, seen_name):
+        """Fingerprint of the partial state's LAYOUT + key code spaces.  Two
+        agents with equal fingerprints produce states indexed identically
+        (same composite group-code meaning), so their states may merge on
+        device BEFORE decode.  Dictionaries fingerprint by CONTENT — two
+        stores ingesting different values hash apart and take the host
+        value-keyed merge instead."""
+        key_fp = []
+        for k in keys:
+            d_fp = (_dict_fingerprint(k.dictionary)
+                    if k.dictionary is not None else None)
+            key_fp.append((k.name, k.kind, k.card, int(k.out_dtype), d_fp,
+                           k.width, k.t0_bin))
+        uda_fp = tuple((name, type(uda).__name__) for name, uda, _vb in udas)
+        return (tuple(key_fp), uda_fp, seen_name,
+                tuple(sorted((k, -1 if v is None else int(v))
+                             for k, v in in_types.items())))
+
+    def _finish_partial_batch(self, keys, udas, state_np, seen_name, in_types):
+        from pixie_tpu.parallel.partial import PartialAggBatch
+
         seen_counts = np.asarray(state_np[seen_name])
         if keys:
             gids = np.nonzero(seen_counts > 0)[0]
@@ -1879,17 +2055,32 @@ class PlanExecutor:
         t0 = _time.perf_counter_ns()
         for sink in self.plan.sinks():
             if isinstance(sink, PartitionSinkOp):
-                # hash-partitioned shuffle edge: one rows channel per bucket
+                # hash-partitioned shuffle edge: one rows channel per bucket.
+                # With a multi-device mesh whose size matches n_parts, the
+                # exchange is ONE lax.all_to_all over the mesh (the ICI
+                # shuffle of SURVEY §2.5; reference splitter.h:114-155);
+                # otherwise the host hash/sort/split exchange.  Both assign
+                # partitions by identical value hashes, so mixed producers
+                # interoperate.
                 from pixie_tpu.parallel.repartition import (
+                    mesh_partition_exchange,
                     partition_ids,
                     split_host_batch,
                 )
 
                 parent = self.plan.parents(sink)[0]
                 hb = self._materialize_parent(parent)
-                part = partition_ids(hb, sink.keys, sink.n_parts)
-                for p, bucket in enumerate(
-                        split_host_batch(hb, part, sink.n_parts)):
+                if (self.mesh is not None
+                        and self.mesh.size == sink.n_parts
+                        and hb.num_rows > 0):
+                    buckets = mesh_partition_exchange(
+                        hb, sink.keys, sink.n_parts, self.mesh)
+                    self.stats["mesh_shuffles"] = (
+                        self.stats.get("mesh_shuffles", 0) + 1)
+                else:
+                    part = partition_ids(hb, sink.keys, sink.n_parts)
+                    buckets = split_host_batch(hb, part, sink.n_parts)
+                for p, bucket in enumerate(buckets):
                     out[f"{sink.prefix}{p}"] = bucket
                 continue
             if not isinstance(sink, ResultSinkOp):

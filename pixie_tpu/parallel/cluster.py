@@ -151,12 +151,21 @@ class LocalCluster:
         payloads: dict[str, list] = {cid: [] for cid in dp.channels}
         agent_stats: dict[str, dict] = {}
 
-        def run_one(agent_name, plan):
-            ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
-                              mesh=self._agent_mesh(agent_name), analyze=analyze)
-            return agent_name, ex.run_agent(), dict(ex.stats)
-
         items = list(dp.agent_plans.items())
+
+        def run_one(agent_name, plan):
+            # route_scale: CPU/TPU routing must see the QUERY size (all
+            # agents' shards), not this agent's shard alone — see
+            # executor._route_backend.
+            ex = PlanExecutor(plan, self.stores[agent_name], self.registry,
+                              mesh=self._agent_mesh(agent_name),
+                              analyze=analyze, route_scale=len(items))
+            # Colocated agents share one device: defer each agent's partial
+            # readback so ALL agents' states come back in ONE transfer wave
+            # below (a per-agent sync pull pays a fixed RTT on remote TPUs —
+            # measured 430 ms for 8 separate pulls vs ~160 ms for one wave).
+            ex.defer_agg_pull = len(items) > 1
+            return agent_name, ex.run_agent(), dict(ex.stats)
         if len(items) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
@@ -164,8 +173,63 @@ class LocalCluster:
                 outs = list(pool.map(lambda kv: run_one(*kv), items))
         else:
             outs = [run_one(*kv) for kv in items]
+        # Deferred agent partials: per channel, either merge all agents'
+        # states ON DEVICE (equal layouts: the SURVEY §2.5 P2 tree reduction
+        # — one readback instead of N) or pull everything in one overlapped
+        # transfer wave and merge by key values on host.
+        from pixie_tpu.engine import transfer
+        from pixie_tpu.engine.executor import (
+            _DeferredPartial,
+            gang_merge_states,
+        )
+
+        by_channel: dict[str, list] = {}
+        for _name, out, _stats in outs:
+            for cid, payload in out.items():
+                if isinstance(payload, _DeferredPartial):
+                    by_channel.setdefault(cid, []).append(payload)
+        finished: dict[int, object] = {}
+        pull_tree = []
+        pull_done = []  # (fn(pulled_subtree) -> None) per entry
+        for cid, ds in by_channel.items():
+            fps = {d.layout_fp for d in ds}
+            if len(fps) == 1 and None not in fps and len(ds) > 1:
+                merged_dev = gang_merge_states(ds)
+                pull_tree.append(merged_dev)
+
+                def done(merged, ds=ds):
+                    # fold in every agent's CPU-feed (hot remainder) state —
+                    # those never entered the device gang merge
+                    host_states = [d.host_state for d in ds
+                                   if d.host_state is not None]
+                    if host_states:
+                        merged = ds[0].host_merge(merged, *host_states)
+                    batch = ds[0].finish_state(merged)
+                    for d in ds:
+                        # all agents resolve to ONE merged batch; keep a
+                        # single payload entry (merge_partials is idempotent
+                        # over one input)
+                        finished[id(d)] = None
+                    finished[id(ds[0])] = batch
+
+                pull_done.append(done)
+            else:
+                for d in ds:
+                    pull_tree.append(d.partials)
+
+                    def done(pulled, d=d):
+                        finished[id(d)] = d.finish(pulled)
+
+                    pull_done.append(done)
+        pulled_all = transfer.pull(pull_tree)
+        for fn, pulled in zip(pull_done, pulled_all):
+            fn(pulled)
         for agent_name, out, stats in outs:
             for cid, payload in out.items():
+                if isinstance(payload, _DeferredPartial):
+                    payload = finished[id(payload)]
+                    if payload is None:
+                        continue  # folded into the gang-merged batch
                 if isinstance(payload, PartialAggBatch):
                     # round-trip the wire format on every query
                     payload = PartialAggBatch.from_bytes(payload.to_bytes())

@@ -153,6 +153,108 @@ def stage_output_inputs(dp, payloads: dict) -> dict:
 
 
 # ------------------------------------------------------- in-mesh all_to_all
+def _device_key_fn(hb, keys):
+    """Build a jittable per-row partition-hash fn matching partition_ids()
+    BIT-FOR-BIT: dict columns hash by VALUE through a host-built per-code
+    LUT, so a mesh-exchanged side and a host-exchanged side of the same join
+    agree on every row's partition."""
+    import jax.numpy as jnp
+
+    luts = {}
+    for k in keys:
+        d = hb.dicts.get(k)
+        if d is not None:
+            uniq = np.asarray(
+                [zlib.crc32(str(v).encode()) for v in d.values()],
+                dtype=np.uint64)
+            luts[k] = _splitmix64(uniq)
+
+    def _sm(z):
+        z = (z + jnp.uint64(_SM_GAMMA)).astype(jnp.uint64)
+        z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(_SM_M1)
+        z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(_SM_M2)
+        return z ^ (z >> jnp.uint64(31))
+
+    def key_fn(cols):
+        first = next(iter(cols.values()))
+        h = jnp.zeros(first.shape[0], dtype=jnp.uint64)
+        for k in keys:
+            col = cols[k]
+            if k in luts:
+                lut = jnp.asarray(luts[k])
+                if lut.shape[0] == 0:
+                    # empty dictionary = every code is null; guard BEFORE
+                    # building the take (a 0-length take fails at trace time)
+                    ch = jnp.full(col.shape, 0x6E756C6C, jnp.uint64)
+                else:
+                    codes = col.astype(jnp.int64)
+                    ch = jnp.where(
+                        codes >= 0,
+                        jnp.take(lut, jnp.clip(codes, 0, lut.shape[0] - 1)),
+                        jnp.uint64(0x6E756C6C),
+                    )
+            else:
+                ch = _sm(col.astype(jnp.int64).view(jnp.uint64))
+            h = h * jnp.uint64(_SM_GAMMA) + ch
+        return _sm(h)
+
+    return key_fn
+
+
+def mesh_partition_exchange(hb, keys, n_parts: int, mesh):
+    """Keyed repartition of a HostBatch over an agent's device mesh: rows
+    shard across devices, ONE lax.all_to_all delivers partition p's rows to
+    device p (the ICI shuffle edge of SURVEY §2.5 — reference splitter's
+    GRPCSink/Source exchange as a single collective), then each device's
+    received block reads back as partition p's HostBatch.
+
+    Requires n_parts == mesh size (device d IS partition d).  Partition
+    assignment matches partition_ids() exactly, so mesh-exchanged and
+    host-exchanged producers interoperate within one join stage.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pixie_tpu.engine.executor import HostBatch
+
+    axis = mesh.axis_names[0]
+    n_dev = int(mesh.shape[axis])
+    if n_parts != n_dev:
+        raise Internal(
+            f"mesh exchange requires n_parts == mesh devices "
+            f"({n_parts} != {n_dev})")
+    rows = hb.num_rows
+    per = max(1, -(-rows // n_dev))  # ceil; >=1 so shards are non-empty
+    padded = per * n_dev
+    part_hash = _device_key_fn(hb, keys)
+    fn = mesh_repartition(mesh, axis, part_hash, dict(hb.dtypes))
+
+    cols_dev = {}
+    for name, col in hb.cols.items():
+        a = np.asarray(col)
+        if padded != rows:
+            a = np.concatenate([a, np.zeros(padded - rows, a.dtype)])
+        cols_dev[name] = a
+    n_valid = np.minimum(
+        np.maximum(rows - per * np.arange(n_dev), 0), per).astype(np.int64)
+    exchanged, counts = fn(cols_dev, n_valid)
+    from pixie_tpu.engine import transfer
+
+    exchanged, counts = transfer.pull((exchanged, counts))
+    # global layout: row-block p*n_dev+i = rows device i sent to partition p;
+    # counts[p*n_dev+i] = how many of those are valid
+    counts = np.asarray(counts).reshape(n_dev, n_dev)
+    out = []
+    for p in range(n_dev):
+        cols_p = {}
+        for name, arr in exchanged.items():
+            blocks = np.asarray(arr).reshape(n_dev, n_dev, per)[p]
+            cols_p[name] = np.concatenate(
+                [blocks[i, : counts[p, i]] for i in range(n_dev)])
+        out.append(HostBatch(dict(hb.dtypes), dict(hb.dicts), cols_p))
+    return out
+
+
 def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
     """Build a jittable keyed repartition over a mesh axis.
 
@@ -173,7 +275,9 @@ def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
     def local(cols, n_valid):
         first = next(iter(cols.values()))
         rows = first.shape[0]
-        part = key_fn(cols) % n_dev
+        # cast after the modulo: a uint64 hash mixed with int64 index math
+        # would silently promote everything to float64
+        part = (key_fn(cols) % n_dev).astype(jnp.int32)
         ridx = jnp.arange(rows)
         valid = ridx < n_valid
         # stable bucket order: sort by (partition, row index)

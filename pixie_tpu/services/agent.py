@@ -132,6 +132,7 @@ class Agent:
             ex = PlanExecutor(
                 plan, self.store, self.registry,
                 analyze=bool(meta.get("analyze", False)),
+                route_scale=int(meta.get("route_scale", 1)),
             )
             t0 = time.perf_counter()
             out = ex.run_agent()

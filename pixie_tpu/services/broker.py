@@ -428,6 +428,9 @@ class Broker:
                 conn.send(wire.encode_json({
                     "msg": "execute", "req_id": req_id,
                     "plan": plan.to_dict(), "analyze": analyze,
+                    # distributed fan-out: agents route CPU/TPU by the
+                    # query's total size, not their local shard's
+                    "route_scale": len(dp.agent_plans),
                 }))
             if dp.agent_plans and not ctx.done.wait(timeout=self.query_timeout_s):
                 raise Unavailable(
