@@ -30,6 +30,7 @@ def parser_registry():
         cql,
         dns,
         http,
+        http2,
         kafka,
         mux,
         mysql,
@@ -40,6 +41,7 @@ def parser_registry():
 
     parsers = [
         http.HTTPParser(),
+        http2.HTTP2Parser(),
         mysql.MySQLParser(),
         pgsql.PgSQLParser(),
         dns.DNSParser(),

@@ -44,6 +44,8 @@ def infer_protocol(data: bytes, direction: str) -> Optional[str]:
     if not data:
         return None
     b0 = data[:1]
+    if data.startswith(b"PRI * HTTP/2.0"):
+        return "http2"  # connection preface (RFC 7540 §3.5)
     _http_starts = (b"GET ", b"POST ", b"PUT ", b"DELETE ", b"HEAD ",
                     b"OPTIONS ", b"PATCH ", b"HTTP/1.")
     if any(data.startswith(s) for s in _http_starts):
