@@ -249,7 +249,13 @@ class ExprCompiler:
             if sa.dictionary is not None and sb.dictionary is not None:
                 return self._host_pair_call(call, udf, non_lit, sa, sb)
         if not non_lit:
-            raise CompilerError(f"{udf.name}: needs one column argument")
+            # all-literal (incl. nullary) host call — environment constants
+            # like px.asid() / px.vizier_id(): evaluate ONCE at compile time
+            # and broadcast as a plain literal (volatile fns re-evaluate per
+            # compile, which is per query — the reference evaluates per row
+            # batch within the same state epoch).
+            val = udf.fn(*[a.value for a in call.args])
+            return self._compile_literal(Literal(val, udf.out_type))
         if len(non_lit) != 1:
             # NOTE: compiling the args may register intermediate LUTs that
             # the composed-origin LUT then supersedes; they still ship with

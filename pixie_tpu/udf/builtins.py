@@ -200,6 +200,109 @@ def register_all(r: Registry) -> None:
     r.register(_enum("mysql_command_name", _S, _mysql_command_name, 0, 32))
     r.register(_enum("protocol_name", _S, _protocol_name, 0, 12))
 
+    # ------------------------------------------------ mixed-type overloads
+    # (reference math_ops.cc registers every UDF for all numeric type pairs.)
+    # Registry.scalar's numeric widening would RESOLVE most of these to the
+    # float overloads with the same results; they are registered explicitly
+    # anyway to mirror the reference's registration surface, pin the exact
+    # out_types independently of widening-rule evolution, and skip the
+    # per-call cast closure on the hot dispatch path.
+    for args in ((_I, _F), (_F, _I)):
+        r.register(_dev("add", args, _F, lambda a, b: a + b))
+        r.register(_dev("subtract", args, _F, lambda a, b: a - b))
+        r.register(_dev("multiply", args, _F, lambda a, b: a * b))
+    for args in ((_I, _I), (_I, _F), (_F, _I)):
+        r.register(_dev("divide", args, _F,
+                        lambda a, b: a.astype(jnp.float64) / b))
+    r.register(_dev("floordiv", (_F, _F), _F,
+                    lambda a, b: jnp.where(b != 0, a // jnp.where(b == 0, 1., b), 0.)))
+    r.register(_dev("pow", (_I, _I), _F,
+                    lambda a, b: jnp.power(a.astype(jnp.float64), b)))
+    r.register(_dev("pow", (_I, _F), _F,
+                    lambda a, b: jnp.power(a.astype(jnp.float64), b)))
+    r.register(_dev("pow", (_F, _I), _F, lambda a, b: jnp.power(a, b)))
+    # time arithmetic: offsets stay times, differences are durations
+    r.register(dataclasses.replace(
+        _dev("add", (_T, _I), _T, lambda a, b: a + b), st_preserve=True))
+    r.register(dataclasses.replace(
+        _dev("add", (_I, _T), _T, lambda a, b: a + b), st_preserve=True))
+    r.register(dataclasses.replace(
+        _dev("subtract", (_T, _I), _T, lambda a, b: a - b), st_preserve=True))
+    r.register(_dev("subtract", (_T, _T), _I, lambda a, b: a - b))
+    # int inputs to float math (implicit widening, reference type expansion)
+    for fname, fn in (("log", jnp.log), ("ln", jnp.log), ("log2", jnp.log2),
+                      ("log10", jnp.log10), ("exp", jnp.exp),
+                      ("sqrt", jnp.sqrt)):
+        r.register(_dev(fname, (_I,), _F,
+                        lambda a, fn=fn: fn(a.astype(jnp.float64))))
+    for fname in ("ceil", "floor", "round"):
+        r.register(_dev(fname, (_I,), _I, lambda a: a))  # already integral
+    r.register(_dev("invert", (_I,), _F, lambda a: 1.0 / a))
+    for args in ((_I, _F), (_F, _I)):
+        r.register(_dev("equal", args, _B, lambda a, b: a == b))
+        r.register(_dev("not_equal", args, _B, lambda a, b: a != b))
+        r.register(_dev("less", args, _B, lambda a, b: a < b))
+        r.register(_dev("less_equal", args, _B, lambda a, b: a <= b))
+        r.register(_dev("greater", args, _B, lambda a, b: a > b))
+        r.register(_dev("greater_equal", args, _B, lambda a, b: a >= b))
+    # lexical string comparisons (host pair/LUT eval; reference string
+    # comparisons via StringValue operator<)
+    r.register(_host("less", (_S, _S), _B, lambda a, b: a < b))
+    r.register(_host("less_equal", (_S, _S), _B, lambda a, b: a <= b))
+    r.register(_host("greater", (_S, _S), _B, lambda a, b: a > b))
+    r.register(_host("greater_equal", (_S, _S), _B, lambda a, b: a >= b))
+
+    # ---------------------------- reference-spelling aliases (math_ops.cc
+    # registers comparison/logical ops under camelCase PxL names)
+    for args in ((_I, _I), (_F, _F), (_T, _T)):
+        r.register(_dev("greaterThan", args, _B, lambda a, b: a > b))
+        r.register(_dev("greaterThanEqual", args, _B, lambda a, b: a >= b))
+        r.register(_dev("lessThan", args, _B, lambda a, b: a < b))
+        r.register(_dev("lessThanEqual", args, _B, lambda a, b: a <= b))
+        r.register(_dev("notEqual", args, _B, lambda a, b: a != b))
+    r.register(_dev("logicalAnd", (_B, _B), _B, jnp.logical_and))
+    r.register(_dev("logicalOr", (_B, _B), _B, jnp.logical_or))
+    r.register(_dev("logicalNot", (_B,), _B, jnp.logical_not))
+    # approxEqual: |a-b| < 1e-9 (reference math_ops.cc ApproxEqualUDF)
+    r.register(_dev("approxEqual", (_F, _F), _B,
+                    lambda a, b: jnp.abs(a - b) < 1e-9))
+
+    # ------------------------------------------- environment constants
+    # (reference metadata_ops.cc ASIDUDF / VizierIDUDF / VizierNameUDF,
+    #  exec_hostname / exec_host_num_cpus) — nullary host calls evaluate at
+    # compile time (eval._host_call all-literal path)
+    # NOTE: the px module exposes the same functions as compile-time
+    # intrinsics (pxmodule.py _exec_hostname etc.); the registry entries
+    # below are the runtime-UDF surface for programmatic plans, and MUST
+    # agree with the intrinsics' sources (metadata snapshot / PL flags).
+    # asid/_exec_hostname read the ambient metadata state: volatile, so
+    # kernels baking their folded values cache per state epoch
+    r.register(dataclasses.replace(_host("asid", (), _I, _asid),
+                                   volatile=True))
+    r.register(_host("vizier_id", (), _S, _vizier_id))
+    r.register(_host("vizier_name", (), _S, _vizier_name))
+    r.register(dataclasses.replace(
+        _host("_exec_hostname", (), _S, _exec_hostname), volatile=True))
+    r.register(_host("_exec_host_num_cpus", (), _I,
+                     lambda: __import__("os").cpu_count() or 1))
+    # int → string; evaluable when the int derives from a dictionary column
+    # (origin composition) or literals — arbitrary dense int columns have no
+    # bounded value domain to LUT over.
+    r.register(_host("itoa", (_I,), _S, lambda v: str(int(v))))
+
+    # ---------------------------------------------------------------- ML ops
+    # (reference ml_ops.h: TransformerUDF/_text_embedding via tflite,
+    # SentencePieceUDF/_encode_sentence_piece, KMeansUDF/_kmeans_inference.
+    # No model weights ship in this environment: the embedder is a
+    # deterministic hashed char-ngram embedding with the same shape contract
+    # — JSON float vector in, JSON float vector out — documented substitute.)
+    r.register(_host("_text_embedding", (_S,), _S, _text_embedding))
+    r.register(_host("_encode_sentence_piece", (_S,), _S,
+                     _encode_sentence_piece))
+    r.register(_host("_kmeans_inference", (_S, _S), _I, _kmeans_inference))
+    r.register(_host("_predict_request_path_cluster", (_S, _S), _S,
+                     _predict_request_path_cluster))
+
     # -------------------------------------------------------------------- UDAs
     r.register_uda("count", CountUDA)
     r.register_uda("sum", SumUDA)
@@ -218,6 +321,122 @@ def register_all(r: Registry) -> None:
 
 
 # ------------------------------------------------------------- host fn helpers
+
+
+def _asid() -> int:
+    """Agent short id from the attached metadata state (reference ASIDUDF
+    reads ctx->metadata_state()->asid())."""
+    try:
+        from pixie_tpu.metadata.state import global_manager
+
+        return int(global_manager().current().asid)
+    except Exception:
+        return 0
+
+
+def _vizier_id() -> str:
+    from pixie_tpu import flags
+
+    return flags.define_str(
+        "PX_VIZIER_ID", "00000000-0000-0000-0000-000000000000", "cluster id")
+
+
+def _vizier_name() -> str:
+    # default MUST match the pxmodule intrinsic's definition — the flags
+    # registry rejects same-flag redefinition with a different default
+    from pixie_tpu import flags
+
+    return flags.define_str("PX_VIZIER_NAME", "pixie-tpu-cluster",
+                            "cluster name")
+
+
+def _exec_hostname() -> str:
+    """Executing node's name: the metadata state's node when attached (same
+    source as the px-module intrinsic), else the OS hostname."""
+    try:
+        from pixie_tpu.metadata.state import global_manager
+
+        name = global_manager().current().node_name
+        if name:
+            return name
+    except Exception:
+        pass
+    import socket
+
+    return socket.gethostname()
+
+
+_EMBED_DIM = 64
+
+
+def _text_embedding(doc: str) -> str:
+    """Deterministic hashed char-trigram embedding (L2-normalized JSON
+    vector).  Substitute for the reference's tflite transformer executor
+    (ml_ops.h TransformerUDF) — same contract, no model weights needed."""
+    import json as _json
+    import math as _math
+    import zlib as _zlib
+
+    vec = [0.0] * _EMBED_DIM
+    s = f"^{doc}$"
+    for i in range(len(s) - 2):
+        h = _zlib.crc32(s[i: i + 3].encode())
+        vec[h % _EMBED_DIM] += 1.0 if (h >> 16) & 1 else -1.0
+    norm = _math.sqrt(sum(v * v for v in vec)) or 1.0
+    return _json.dumps([round(v / norm, 6) for v in vec])
+
+
+def _encode_sentence_piece(doc: str) -> str:
+    """Whitespace+punctuation tokenizer → stable hashed token ids (JSON).
+    Substitute for the reference's sentencepiece model (ml_ops.h
+    SentencePieceUDF) with the same ids-list contract."""
+    import json as _json
+    import zlib as _zlib
+
+    toks = re.findall(r"[A-Za-z0-9_]+|[^\sA-Za-z0-9_]", doc)
+    return _json.dumps([_zlib.crc32(t.lower().encode()) % 32000 for t in toks])
+
+
+def _kmeans_inference(embedding_json: str, model_json: str) -> int:
+    """Nearest centroid (reference ml_ops.h KMeansUDF: embedding × kmeans
+    model json → cluster index)."""
+    import json as _json
+
+    try:
+        x = _json.loads(embedding_json)
+        model = _json.loads(model_json)
+        cents = model.get("centroids", model) if isinstance(model, dict) \
+            else model
+        best, best_d = -1, float("inf")
+        for i, c in enumerate(cents):
+            d = sum((a - b) ** 2 for a, b in zip(x, c))
+            if d < best_d:
+                best, best_d = i, d
+        return best
+    except (ValueError, TypeError):
+        return -1
+
+
+def _predict_request_path_cluster(req_path: str, clusters_json: str) -> str:
+    """Nearest request-path cluster by template similarity (reference
+    request_path_ops.cc PredictRequestPathClusterUDF: path × clustering
+    model → representative template)."""
+    import json as _json
+
+    from pixie_tpu.ml.request_path import RequestPathClustering
+
+    try:
+        clusters = _json.loads(clusters_json)
+    except (ValueError, TypeError):
+        return ""
+    if not isinstance(clusters, list) or not clusters:
+        return ""
+    model = RequestPathClustering()
+    model.templates = sorted(
+        c.get("template", "") if isinstance(c, dict) else str(c)
+        for c in clusters
+    )
+    return model.predict(req_path)
 
 
 def _atoi(s: str) -> int:

@@ -237,3 +237,128 @@ def test_uri_and_rule_builtins_malformed_inputs():
     assert match("/x", "null") == ""
     assert match("/x", '{"r": 5}') == ""       # non-string pattern
     assert match("/x", "not json") == ""
+
+
+def test_registration_count_ratchet():
+    """VERDICT r3 item 5: live registrations >= 240 (the reference registers
+    ~250 across funcs/; this must never silently shrink)."""
+    from pixie_tpu.udf import registry
+
+    total = (sum(len(v) for v in registry._scalar.values())
+             + len(registry._uda) + len(registry._udtf))
+    assert total >= 240, total
+
+
+def test_mixed_and_time_arithmetic():
+    ts = TableStore()
+    ts.create("t", Relation.of(
+        ("time_", DT.TIME64NS), ("i", DT.INT64), ("f", DT.FLOAT64))).write(
+        {"time_": [1000, 2000], "i": [4, 9], "f": [0.5, 2.0]})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='t')\n"
+        "df.mixed = df.i * df.f\n"
+        "df.ratio = df.i / 2\n"
+        "df.t2 = df.time_ + 500\n"
+        "df.dt = df.t2 - df.time_\n"
+        "df.r = px.sqrt(df.i)\n"
+        "df = df[['mixed', 'ratio', 't2', 'dt', 'r']]\n"
+        "px.display(df, 'o')\n",
+        ts.schemas(),
+    )
+    res = execute_plan(q.plan, ts)["o"].to_pandas()
+    assert list(res["mixed"]) == [2.0, 18.0]
+    assert list(res["ratio"]) == [2.0, 4.5]
+    assert list(res["t2"]) == [1500, 2500]
+    assert list(res["dt"]) == [500, 500]
+    assert list(res["r"]) == [2.0, 3.0]
+
+
+def test_string_lexical_comparison():
+    ts = TableStore()
+    ts.create("t", Relation.of(("a", DT.STRING), ("b", DT.STRING))).write(
+        {"a": ["apple", "pear", "zed"], "b": ["banana", "pear", "aa"]})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='t')\n"
+        "df = df[df.a < df.b]\n"
+        "px.display(df, 'o')\n",
+        ts.schemas(),
+    )
+    res = execute_plan(q.plan, ts)["o"]
+    assert res.decoded("a") == ["apple"]
+
+
+def test_environment_constant_builtins():
+    import os
+
+    from pixie_tpu.udf import registry
+
+    # the registry (runtime-UDF) surface: nullary env constants
+    host_fn = registry.scalar("_exec_hostname", ()).fn
+    cpus_fn = registry.scalar("_exec_host_num_cpus", ()).fn
+    asid_fn = registry.scalar("asid", ()).fn
+    assert isinstance(host_fn(), str) and host_fn()
+    assert cpus_fn() == (os.cpu_count() or 1)
+    assert isinstance(asid_fn(), int)
+    vid = registry.scalar("vizier_id", ()).fn()
+    assert isinstance(vid, str) and len(vid) >= 32
+
+    # the same constants fold through a PxL query (px-module intrinsics are
+    # compile-time; the engine broadcasts the value)
+    ts = TableStore()
+    ts.create("t", Relation.of(("v", DT.INT64))).write({"v": [1, 2]})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='t')\n"
+        "df.cpus = px._exec_host_num_cpus()\n"
+        "px.display(df, 'o')\n",
+        ts.schemas(),
+    )
+    res = execute_plan(q.plan, ts)["o"]
+    assert set(res.decoded("cpus")) == {os.cpu_count() or 1}
+
+
+def test_ml_builtins():
+    import json as _json
+
+    from pixie_tpu.udf import registry
+
+    emb = registry.scalar("_text_embedding", (DT.STRING,)).fn
+    v1, v2 = _json.loads(emb("GET /api/users")), _json.loads(emb("GET /api/users"))
+    assert v1 == v2 and len(v1) == 64
+    assert abs(sum(x * x for x in v1) - 1.0) < 1e-3  # L2-normalized
+    assert _json.loads(emb("something else")) != v1
+
+    sp = registry.scalar("_encode_sentence_piece", (DT.STRING,)).fn
+    ids = _json.loads(sp("hello, world"))
+    assert len(ids) == 3 and all(0 <= i < 32000 for i in ids)
+
+    km = registry.scalar("_kmeans_inference", (DT.STRING, DT.STRING)).fn
+    model = _json.dumps({"centroids": [[0.0, 0.0], [10.0, 10.0]]})
+    assert km("[1.0, 1.0]", model) == 0
+    assert km("[9.0, 11.0]", model) == 1
+    assert km("not json", model) == -1
+
+    pred = registry.scalar(
+        "_predict_request_path_cluster", (DT.STRING, DT.STRING)).fn
+    clusters = _json.dumps([{"template": "/api/users/*"},
+                            {"template": "/health"}])
+    assert pred("/api/users/123", clusters) == "/api/users/*"
+    assert pred("/health", clusters) == "/health"
+
+
+def test_itoa_via_origin_composition():
+    """itoa works on ints derived from a dictionary column (origin path)."""
+    ts = TableStore()
+    ts.create("t", Relation.of(("s", DT.STRING))).write(
+        {"s": ["12", "7", "12"]})
+    q = compile_pxl(
+        "import px\n"
+        "df = px.DataFrame(table='t')\n"
+        "df.back = px.itoa(px.atoi(df.s) + 1)\n"
+        "px.display(df, 'o')\n",
+        ts.schemas(),
+    )
+    res = execute_plan(q.plan, ts)["o"]
+    assert res.decoded("back") == ["13", "8", "13"]
