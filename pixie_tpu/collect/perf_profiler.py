@@ -47,14 +47,47 @@ class PerfProfilerConnector(SourceConnector):
         self.push_period_s = push_period_s
         import os
 
-        self._upid = UInt128.make_upid(asid, pid if pid is not None else os.getpid(),
-                                       time.time_ns())
+        from pixie_tpu.metadata.proc_scanner import pid_start_time_ns
+
+        rpid = pid if pid is not None else os.getpid()
+        # /proc-derived start time, NOT time.time_ns(): the UPID must equal
+        # the one the ProcScanner binds in the metadata state, or ctx['pod']
+        # never joins profiler rows
+        self._upid = UInt128.make_upid(
+            asid, rpid, pid_start_time_ns(rpid) or time.time_ns())
         self._counts: Counter[str] = Counter()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._stack_ids: dict[str, int] = {}
         self.samples_taken = 0
+        #: lazily-built native symbolizer (obj_tools): resolves raw return
+        #: addresses from externally-captured native stacks (perf-script
+        #: replay, ptrace samplers) against /proc/<pid>/maps + ELF symtabs —
+        #: the reference's symbolizer stage (perf_profiler/symbolizers/).
+        self._native_sym = None
+
+    # ------------------------------------------------------ native frames
+    def _symbolizer(self):
+        if self._native_sym is None:
+            from pixie_tpu.obj_tools import NativeSymbolizer
+
+            self._native_sym = NativeSymbolizer(self._upid.pid)
+        return self._native_sym
+
+    def fold_native_stack(self, addrs: list[int]) -> str:
+        """Raw leaf-first return addresses → root-first folded symbol string
+        (same format as the Python sampler's fold_stack)."""
+        sym = self._symbolizer()
+        return ";".join(sym.symbolize(a) for a in reversed(addrs))
+
+    def add_native_sample(self, addrs: list[int], count: int = 1) -> None:
+        """Ingest one externally-captured native stack (leaf-first raw
+        addresses); symbolized + merged into the same folded-count table the
+        Python sampler fills."""
+        folded = self.fold_native_stack(addrs)
+        with self._lock:
+            self._counts[folded] += count
 
     def tables(self) -> list[TableSpec]:
         # reference stack_traces_table.h:31

@@ -41,6 +41,15 @@ class TapProxy:
         self._next_conn = 0
         self._lock = threading.Lock()
 
+    def _pid_start_ns(self) -> int:
+        if self.pid <= 0:
+            return 0
+        if not hasattr(self, "_start_ns"):
+            from pixie_tpu.metadata.proc_scanner import pid_start_time_ns
+
+            self._start_ns = pid_start_time_ns(self.pid)
+        return self._start_ns
+
     def start(self) -> "TapProxy":
         t = threading.Thread(target=self._accept_loop, name="tap-accept",
                              daemon=True)
@@ -67,6 +76,10 @@ class TapProxy:
                 continue
             self.source.emit({
                 "ev": "open", "conn": cid, "pid": self.pid,
+                # real start time from /proc so the traffic's UPID matches
+                # the ProcScanner-fed metadata state (ctx['pod'] joins on
+                # the exact UInt128)
+                "pid_start_ns": self._pid_start_ns(),
                 "addr": addr[0], "port": self.upstream[1],
                 # tap sits in front of the server: server-side semantics
                 "role": 2, "protocol": self.protocol,

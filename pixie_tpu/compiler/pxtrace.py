@@ -57,6 +57,120 @@ def parse_program_schema(program: str) -> Relation:
     return Relation(cols)
 
 
+_PROBE_DECL_RE = re.compile(
+    r"^\s*(kprobe|kretprobe|uprobe|uretprobe|tracepoint|usdt|k|kr|u|ur|t)"
+    r":([^\s{]+)\s*(?:/[^/]*/\s*)?\{", re.M)
+_ASSIGN_RE = re.compile(r"\$([A-Za-z_][A-Za-z_0-9]*)\s*=[^=]")
+_VARREF_RE = re.compile(r"\$([A-Za-z_][A-Za-z_0-9]*)")
+#: bpftrace builtins legal without declaration (bpftrace reference manual)
+_BUILTINS = {
+    "pid", "tid", "uid", "gid", "nsecs", "elapsed", "cpu", "comm", "curtask",
+    "rand", "cgroup", "func", "probe", "retval", "args", "arg0", "arg1",
+    "arg2", "arg3", "arg4", "arg5", "arg6", "arg7", "arg8", "arg9",
+    "kstack", "ustack", "username",
+}
+
+
+def validate_program(program: str, probe_kind: str) -> None:
+    """Compile-time validation of a bpftrace-dialect tracepoint program
+    (reference: probes/tracepoint_generator.cc validates the logical program
+    + resolves target symbols BEFORE deployment; an invalid program must
+    fail at compile, not at agent attach).
+
+    Checks: at least one probe declaration matching the declared probe kind;
+    balanced braces; printf argument count matches its format specs; every
+    `$var` reference is assigned before use within the program; uprobe
+    targets name an existing symbol when the binary is readable locally.
+    """
+    # strip string literals first: $tokens/braces INSIDE printf strings are
+    # data, not code (a format like "cost $USD {" must not trip the checks)
+    stripped = re.sub(r'"(?:[^"\\]|\\.)*"', '""', program)
+    if stripped.count("{") != stripped.count("}"):
+        raise CompilerError("pxtrace program: unbalanced braces")
+    decls = _PROBE_DECL_RE.findall(program)
+    if not decls:
+        raise CompilerError(
+            "pxtrace program declares no probe (expected e.g. "
+            "'kprobe:tcp_drop { ... }')")
+    kinds = {k for k, _t in decls}
+    short = {"k": "kprobe", "kr": "kretprobe", "u": "uprobe",
+             "ur": "uretprobe", "t": "tracepoint"}
+    kinds = {short.get(k, k) for k in kinds}
+    if probe_kind == "kprobe" and not (kinds & {"kprobe", "kretprobe"}):
+        raise CompilerError(
+            f"pxtrace: probe declared as kprobe() but program probes {kinds}")
+    if probe_kind == "uprobe" and not (kinds & {"uprobe", "uretprobe",
+                                                "usdt"}):
+        raise CompilerError(
+            f"pxtrace: probe declared as uprobe() but program probes {kinds}")
+    if probe_kind == "tracepoint" and "tracepoint" not in kinds:
+        raise CompilerError(
+            f"pxtrace: probe declared as tracepoint() but program "
+            f"probes {kinds}")
+
+    # printf arity: count %-specs (not %%) vs trailing args
+    for m in re.finditer(r'printf\(\s*"((?:[^"\\]|\\.)*)"\s*((?:,[^;]*)?)\)',
+                         program, re.S):
+        fmt, args = m.group(1), m.group(2)
+        nspec = len(re.findall(r"%[-+ 0-9.]*[a-zA-Z]", fmt.replace("%%", "")))
+        nargs = _count_call_args(args)
+        if nspec != nargs:
+            raise CompilerError(
+                f"pxtrace printf: format has {nspec} specs but "
+                f"{nargs} arguments")
+
+    # $var def-before-use, per probe body scan order (string-stripped text)
+    assigned: set[str] = set()
+    for stmt in re.split(r"[;{}]", stripped):
+        for name in _ASSIGN_RE.findall(stmt):
+            assigned.add(name)
+        for name in _VARREF_RE.findall(stmt):
+            if name not in assigned and name not in _BUILTINS:
+                raise CompilerError(
+                    f"pxtrace: ${name} referenced before assignment")
+
+    # uprobe symbol resolution against the local binary (when readable)
+    for kind, target in decls:
+        if short.get(kind, kind) not in ("uprobe", "uretprobe"):
+            continue
+        if ":" not in target:
+            raise CompilerError(
+                f"pxtrace uprobe target {target!r} must be <path>:<symbol>")
+        path, sym = target.rsplit(":", 1)
+        import os
+
+        if os.path.isfile(path):
+            from pixie_tpu.obj_tools import ElfReader
+
+            try:
+                rd = ElfReader(path)
+                found = rd.has_symbol(sym)
+            except Exception as e:  # malformed ELF must fail as a compile
+                raise CompilerError(  # error, not a raw parser traceback
+                    f"pxtrace uprobe: cannot read symbols of {path}: {e}"
+                ) from e
+            if not found:
+                raise CompilerError(
+                    f"pxtrace uprobe: {path} has no symbol {sym!r}")
+
+
+def _count_call_args(argstr: str) -> int:
+    """Top-level comma count of a printf tail (', a, f(b, c)' -> 2)."""
+    s = argstr.strip()
+    if not s:
+        return 0
+    depth = 0
+    count = 0
+    for ch in s:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            count += 1
+    return count
+
+
 @dataclasses.dataclass(frozen=True)
 class ProbeSpec:
     kind: str  # kprobe | uprobe | tracepoint
@@ -89,6 +203,7 @@ class PxTraceModule(types.ModuleType):
             raise CompilerError(
                 "UpsertTracepoint: probe must be pxtrace.kprobe()/uprobe()/tracepoint()"
             )
+        validate_program(program, probe.kind)
         rel = parse_program_schema(program)
         ttl_ns = timeparse.parse_duration_ns(ttl) if isinstance(ttl, str) else int(ttl)
         if ttl_ns <= 0:

@@ -174,6 +174,13 @@ def main(argv=None):
     ap.add_argument("--heartbeat-s", type=float, default=DEFAULT_HEARTBEAT_S)
     ap.add_argument("--auth-token", default=None,
                     help="shared secret; required if the broker enables auth")
+    ap.add_argument("--proc-scan-s", type=float, default=0.0,
+                    help="scan /proc every N seconds, binding live PIDs to "
+                         "UPIDs (+pods via cgroup) in the metadata state "
+                         "(reference pids.cc); 0 disables")
+    ap.add_argument("--watch-feed", default=None,
+                    help="JSONL file of ResourceUpdates to tail into the "
+                         "metadata state (the k8s watch fanout analog)")
     args = ap.parse_args(argv)
     host, port = args.broker.rsplit(":", 1)
 
@@ -221,6 +228,35 @@ def main(argv=None):
                 tap.source, name=f"socket_tracer:tap:{tap.port}"))
         else:
             raise SystemExit(f"unknown connector {cname!r}")
+    md_jobs = []
+    if args.proc_scan_s > 0 or args.watch_feed:
+        from pixie_tpu.metadata.state import global_manager
+
+        mgr = global_manager()
+        if args.proc_scan_s > 0:
+            from pixie_tpu.metadata.proc_scanner import ProcScanner
+
+            md_jobs.append((args.proc_scan_s,
+                            ProcScanner(asid=mgr.current().asid).scan_into,
+                            mgr))
+        if args.watch_feed:
+            from pixie_tpu.metadata.watch import ResourceUpdateFeed
+
+            feed = ResourceUpdateFeed(mgr, args.watch_feed)
+            md_jobs.append((1.0, lambda _m, feed=feed: feed.poll(), mgr))
+
+    def _md_loop(period, fn, mgr):
+        while True:
+            try:
+                fn(mgr)
+            except Exception:
+                pass  # metadata refresh must never kill the agent
+            time.sleep(period)
+
+    for period, fn, mgr in md_jobs:
+        threading.Thread(target=_md_loop, args=(period, fn, mgr),
+                         daemon=True).start()
+
     agent = Agent(args.name, host, int(port), collector=collector,
                   heartbeat_s=args.heartbeat_s, auth_token=args.auth_token)
     agent.start()

@@ -1,0 +1,184 @@
+"""ELF reading, native symbolization, and validated pxtrace compilation.
+
+Reference: obj_tools/elf_reader.cc (symbol iteration + addr lookup),
+perf_profiler/symbolizers/ (native frame symbolization), and
+planner/probes/tracepoint_generator.cc (programs validated at compile time,
+with uprobe targets resolved against the binary's symbols).
+"""
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import textwrap
+
+import pytest
+
+from pixie_tpu.obj_tools import ElfReader, NativeSymbolizer
+from pixie_tpu.status import CompilerError
+
+
+@pytest.fixture(scope="module")
+def small_binary(tmp_path_factory):
+    """A tiny unstripped C binary with known symbols."""
+    d = tmp_path_factory.mktemp("elf")
+    src = d / "t.c"
+    src.write_text(textwrap.dedent("""
+        extern "C" int target_alpha(int x) { return x + 1; }
+        extern "C" int target_beta(int x) { return target_alpha(x) * 2; }
+        int main(void) { return target_beta(20); }
+    """))
+    out = d / "t.bin"
+    subprocess.run(["g++", "-O0", "-o", str(out), str(src)], check=True)
+    return str(out)
+
+
+class TestElfReader:
+    def test_symbols_of_compiled_binary(self, small_binary):
+        rd = ElfReader(small_binary)
+        names = {s.name for s in rd.symbols()}
+        assert {"target_alpha", "target_beta", "main"} <= names
+        a = rd.symbol("target_alpha")
+        assert a.is_func and a.size > 0
+
+    def test_symbolize_addr_inside_function(self, small_binary):
+        rd = ElfReader(small_binary)
+        b = rd.symbol("target_beta")
+        assert rd.symbolize(b.addr) == "target_beta"
+        assert rd.symbolize(b.addr + b.size - 1) == "target_beta"
+
+    def test_libc_dynsym(self):
+        ns = NativeSymbolizer()
+        libc = next((p for _, _, _, p in ns.maps
+                     if "/libc.so" in p or "/libc-" in p), None)
+        assert libc, "no libc mapping found"
+        rd = ElfReader(libc)
+        assert rd.has_symbol("malloc")
+        assert not rd.has_symbol("definitely_not_a_symbol_xyz")
+
+    def test_not_an_elf(self, tmp_path):
+        p = tmp_path / "x.txt"
+        p.write_text("hello")
+        with pytest.raises(ValueError):
+            ElfReader(str(p))
+
+
+class TestNativeSymbolizer:
+    def test_live_libc_address(self):
+        lc = ctypes.CDLL("libc.so.6")
+        addr = ctypes.cast(lc.printf, ctypes.c_void_p).value
+        got = NativeSymbolizer().symbolize(addr)
+        assert "printf" in got and "libc" in got
+
+    def test_unknown_address_hex(self):
+        assert NativeSymbolizer().symbolize(0x10) == hex(0x10)
+
+    def test_profiler_native_sample(self):
+        from pixie_tpu.collect.perf_profiler import PerfProfilerConnector
+
+        lc = ctypes.CDLL("libc.so.6")
+        a1 = ctypes.cast(lc.printf, ctypes.c_void_p).value
+        a2 = ctypes.cast(lc.malloc, ctypes.c_void_p).value
+        conn = PerfProfilerConnector(push_period_s=0.0)
+        conn.add_native_sample([a1, a2], count=3)  # leaf-first: printf<-malloc
+        rows = conn.transfer_data()["stack_traces.beta"]
+        assert rows["count"] == [3]
+        folded = rows["stack_trace"][0]
+        assert "malloc" in folded and "printf" in folded
+        # root-first order: caller (malloc? no — a2 is leaf's caller) —
+        # leaf-first input [printf, malloc] folds to 'malloc...;printf...'
+        assert folded.index("malloc") < folded.index("printf")
+
+
+# ------------------------------------------------------- pxtrace validation
+VALID_KPROBE = """
+kprobe:tcp_drop
+{
+  $sk = (struct sock *) arg0;
+  printf("time_:%llu pid:%u state:%s", nsecs, pid, $sk);
+}
+"""
+
+
+class TestPxtraceValidation:
+    def _compile(self, program, probe="pxtrace.kprobe()"):
+        from pixie_tpu.compiler import compile_pxl
+
+        src = (
+            "import px\nimport pxtrace\n"
+            "pxtrace.UpsertTracepoint('tp', 'tp_table', program, "
+            f"{probe}, '10m')\n"
+            "df = px.DataFrame(table='tp_table')\npx.display(df, 'o')\n"
+        )
+        return compile_pxl(src.replace("program", repr(program)), {})
+
+    def test_valid_program_compiles(self):
+        q = self._compile(VALID_KPROBE)
+        assert q.mutations and q.mutations[0]["table_name"] == "tp_table"
+
+    def test_unbalanced_braces(self):
+        with pytest.raises(CompilerError, match="unbalanced"):
+            self._compile("kprobe:f { printf(\"x:%d\", pid);")
+
+    def test_no_probe_declaration(self):
+        with pytest.raises(CompilerError, match="declares no probe"):
+            self._compile("{ printf(\"x:%d\", pid); }")
+
+    def test_probe_kind_mismatch(self):
+        with pytest.raises(CompilerError, match="declared as tracepoint"):
+            self._compile(VALID_KPROBE, probe="pxtrace.tracepoint()")
+
+    def test_printf_arity_mismatch(self):
+        bad = 'kprobe:f { printf("a:%d b:%d", pid); }'
+        with pytest.raises(CompilerError, match="2 specs but 1"):
+            self._compile(bad)
+
+    def test_undefined_variable(self):
+        bad = 'kprobe:f { printf("a:%d", $nope); }'
+        with pytest.raises(CompilerError, match=r"\$nope referenced"):
+            self._compile(bad)
+
+    def test_uprobe_missing_symbol_fails(self, small_binary):
+        bad = ('uprobe:%s:no_such_symbol { printf("t:%%llu", nsecs); }'
+               % small_binary)
+        with pytest.raises(CompilerError, match="no symbol"):
+            self._compile(bad, probe="pxtrace.uprobe()")
+
+    def test_uprobe_real_symbol_compiles(self, small_binary):
+        ok = ('uprobe:%s:target_beta { printf("t:%%llu pid:%%u", nsecs, pid); }'
+              % small_binary)
+        q = self._compile(ok, probe="pxtrace.uprobe()")
+        assert q.mutations
+
+    def test_reference_tcp_drops_program_compiles(self):
+        """The actual bundled tcp_drops bpftrace program validates clean."""
+        import pathlib
+        import re as _re
+
+        src = pathlib.Path(
+            "/root/reference/src/pxl_scripts/px/tcp_drops/data.pxl"
+        ).read_text()
+        m = _re.search(r'program = """(.*?)"""', src, _re.S)
+        assert m
+        from pixie_tpu.compiler.pxtrace import validate_program
+
+        validate_program(m.group(1), "kprobe")
+
+
+class TestValidationReviewRegressions:
+    def test_dollar_and_brace_inside_strings_ok(self):
+        from pixie_tpu.compiler.pxtrace import validate_program
+
+        ok = 'kprobe:f { printf("cost_usd:%d paid {$USD}", pid); }'
+        validate_program(ok, "kprobe")  # must not raise
+
+    def test_malformed_elf_is_compile_error(self, tmp_path):
+        from pixie_tpu.compiler.pxtrace import validate_program
+
+        # valid ELF magic + truncated garbage: parser must surface a
+        # CompilerError, not a raw IndexError/struct.error traceback
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"\x7fELF" + b"\x02\x01\x01" + b"\x00" * 9
+                      + b"\xff" * 48)
+        prog = 'uprobe:%s:foo { printf("t:%%llu", nsecs); }' % p
+        with pytest.raises(CompilerError):
+            validate_program(prog, "uprobe")

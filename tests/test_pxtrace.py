@@ -20,6 +20,11 @@ from pixie_tpu.types import DataType as DT
 PROGRAM = '''
 kprobe:tcp_drop
 {
+  $saddr = ntop(0);
+  $daddr = ntop(0);
+  $sport = 0;
+  $dport = 0;
+  $statestr = "EST";
   printf("time_:%llu pid:%u src_ip:%s src_port:%d dst_ip:%s dst_port:%d state:%s",
     nsecs, pid, $saddr, $sport, $daddr, $dport, $statestr);
 }
