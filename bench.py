@@ -343,6 +343,30 @@ px.display(df, 'win')
     return rows / secs
 
 
+def kernel_split(plan, ts):
+    """One analyze-mode run → {e2e_ms, op_wall_ms, device_kernel_ms}.
+
+    The roofline note becomes numbers (VERDICT r3 item 9): device_kernel_ms
+    sums the per-feed block_until_ready times (pure device execution);
+    op_wall_ms is the compiled units' wall time including host feed/readback;
+    the difference to e2e is compile/plan/python overhead.
+    """
+    from pixie_tpu.engine.executor import PlanExecutor
+
+    ex = PlanExecutor(plan, ts, analyze=True)
+    t0 = time.perf_counter()
+    ex.run()
+    e2e = time.perf_counter() - t0
+    # self_ns: wall minus nested frames (blocking ops nest their inputs)
+    op_wall = sum(r.get("self_ns", r.get("wall_ns", 0)) for r in ex.op_stats)
+    dev = sum(sum(r.get("feed_ns", [])) for r in ex.op_stats)
+    return {
+        "e2e_ms": round(e2e * 1000, 1),
+        "op_wall_ms": round(op_wall / 1e6, 1),
+        "device_kernel_ms": round(dev / 1e6, 1),
+    }
+
+
 def bench_ingest(rows):
     """Standalone ingest microbench: raw Table.write throughput including
     dictionary encoding of a string column through the native index
@@ -444,6 +468,12 @@ def main():
             mxu = mxu_flops_estimate(n, t_secs)
             cfg2 = bench_config2(ts, n, args.repeats)
             cfg2_base = pandas_config2(ts, n, 1)
+            # device-kernel vs end-to-end split at the headline size
+            split = {
+                "1_groupby": kernel_split(http_plan(), ts),
+                "2_windowed_quantiles": kernel_split(
+                    http_plan(windowed_ns=10 * SEC, quantiles=True), ts),
+            }
         del ts
 
     cfg3 = bench_config3(args.join_rows, args.repeats)
@@ -476,6 +506,10 @@ def main():
                 "bytes_per_sec": round(ingest_bps),
             },
         },
+        #: per-config device-kernel vs end-to-end time at the headline size —
+        #: e2e - op_wall = plan/compile/python; op_wall - device_kernel =
+        #: host feed assembly + readback waits (the tunneled-runtime tax)
+        "exec_split": split,
         "mxu_est": {
             "achieved_flops_per_sec": round(mxu),
             "mfu_vs_peak": round(mxu / peak, 6),

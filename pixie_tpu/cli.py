@@ -181,19 +181,16 @@ def cmd_run(args) -> int:
 
         if len(runs) > 1:
             # Multi-widget vis: fuse all funcs' plans so shared subplans
-            # (scans, filters, first aggregates) execute ONCE
-            # (reference MergeNodesRule, optimizer.h:39).
-            from pixie_tpu.plan.fusion import fuse_compiled
+            # (scans, filters, first aggregates) execute ONCE — via the same
+            # compile path the broker uses (reference MergeNodesRule,
+            # optimizer.h:39 fuses in the compiler so every entry point
+            # benefits).
+            from pixie_tpu.compiler import compile_pxl_funcs
 
-            compiled = [
-                (out, compile_pxl(source, schemas, func=fn, func_args=fargs,
-                                  now=now))
-                for out, fn, fargs in runs
-            ]
-            fused, sink_map, muts = fuse_compiled(compiled)
-            if muts:
-                tp_mgr.apply(muts)
-            all_results = execute_plan(fused, store, analyze=args.analyze)
+            q, sink_map = compile_pxl_funcs(source, schemas, runs, now=now)
+            if q.mutations:
+                tp_mgr.apply(q.mutations)
+            all_results = execute_plan(q.plan, store, analyze=args.analyze)
 
             def execute_fused(out_name):
                 return {

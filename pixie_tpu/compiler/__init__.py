@@ -1,10 +1,16 @@
-from pixie_tpu.compiler.compiler import CompiledQuery, compile_fn, compile_pxl
+from pixie_tpu.compiler.compiler import (
+    CompiledQuery,
+    compile_fn,
+    compile_pxl,
+    compile_pxl_funcs,
+)
 from pixie_tpu.compiler.pxl import CompileCtx, DataFrame, GroupedDataFrame, Scalar
 from pixie_tpu.compiler.pxmodule import PxModule
 
 __all__ = [
     "CompiledQuery",
     "compile_fn",
+    "compile_pxl_funcs",
     "compile_pxl",
     "CompileCtx",
     "DataFrame",

@@ -279,6 +279,45 @@ def compile_pxl(
                          now=ctx.now, mutations=list(ctx.mutations))
 
 
+def compile_pxl_funcs(
+    source: str,
+    schemas: dict[str, Relation],
+    funcs: list,
+    registry=None,
+    now: Optional[int] = None,
+    default_limit: Optional[int] = None,
+):
+    """Compile SEVERAL vis funcs of one script and fuse their plans so
+    shared subplans (scans, filters, first aggregates) execute once
+    (reference MergeNodesRule, optimizer/optimizer.h:39 — the reference
+    fuses in the compiler so every entry point benefits; this is that shared
+    entry point for the CLI and the broker alike).
+
+    funcs: [(prefix, func_name, func_args)] — prefix labels the widget.
+    Returns (fused CompiledQuery, sink_map) where
+    sink_map[prefix][original_sink] = fused sink name.
+    """
+    from pixie_tpu.plan.fusion import fuse_compiled
+
+    compiled = [
+        (prefix, compile_pxl(source, schemas, func=fn, func_args=fargs,
+                             registry=registry, now=now,
+                             default_limit=default_limit))
+        for prefix, fn, fargs in funcs
+    ]
+    if len(compiled) == 1:
+        q = compiled[0][1]
+        sink_map = {compiled[0][0]: {s: s for s in q.sink_names}}
+        return q, sink_map
+    fused, sink_map, muts = fuse_compiled(compiled)
+    return CompiledQuery(
+        plan=fused,
+        sink_names=[s for m in sink_map.values() for s in m.values()],
+        now=compiled[0][1].now,
+        mutations=muts,
+    ), sink_map
+
+
 def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> CompiledQuery:
     """Compile a Python callable `build(px)` directly (no source text) — the
     programmatic API used by services and tests."""

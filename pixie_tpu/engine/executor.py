@@ -301,16 +301,6 @@ def _route_backend(src, scale: int = 1) -> str:
     return "tpu"
 
 
-def _small_input_device(src, scale: int = 1):
-    """Context manager routing kernel dispatch to CPU below the crossover.
-    Only uncommitted (numpy) inputs follow the default device, so TPU-cached
-    feeds keep their placement — the context is a preference, not a forced
-    transfer."""
-    if _route_backend(src, scale) == "cpu":
-        return jax.default_device(_cpu_device())
-    return _contextlib.nullcontext()
-
-
 def _iter_call_fns(expr):
     """Yield every Call fn name in an expression tree."""
     if isinstance(expr, Call):
@@ -850,6 +840,65 @@ class _DeferredPartial:
     host_merge: Optional[Callable] = None
 
 
+#: jitted state packers keyed by (treedef, leaf specs): on a remote/tunneled
+#: runtime every pulled LEAF pays a round trip, so the agg state (several
+#: arrays: per-UDA accumulators + seen counts) is concatenated into ONE
+#: buffer per distinct dtype in the same device program and unpacked from
+#: the pulled buffers on host — the readback batched into the kernel's final
+#: step.  Grouping is by dtype (not a single bitcast byte buffer) because
+#: this runtime's X64 rewrite cannot compile bitcast-converts of 64-bit
+#: element types.
+_PACK_CACHE: dict = {}
+
+
+@dataclasses.dataclass
+class _PackedState:
+    """A partial state living on device as per-dtype packed buffers."""
+
+    buf: object  # tuple of concatenated per-dtype arrays
+    unpack: Callable
+
+
+def _state_packer(sample_state):
+    """(pack_jit, unpack_np) for states shaped like `sample_state`, or None
+    when packing cannot reduce the pulled leaf count (already one leaf per
+    dtype) — the pack is a separate jitted dispatch, so a no-gain pack is
+    pure overhead."""
+    leaves, treedef = jax.tree.flatten(sample_state)
+    spec = tuple((tuple(l.shape), np.dtype(l.dtype).str) for l in leaves)
+    key = (treedef, spec)
+    got = _PACK_CACHE.get(key)
+    if got is not None:
+        return got
+    dtypes = sorted({d for _s, d in spec})
+    if len(spec) <= len(dtypes):
+        _PACK_CACHE[key] = None
+        return None
+
+    def pack(state):
+        ls, _ = jax.tree.flatten(state)
+        groups = {d: [] for d in dtypes}
+        for x, (_shape, d) in zip(ls, spec):
+            groups[d].append(x.reshape(-1))
+        return tuple(jnp.concatenate(groups[d]) for d in dtypes)
+
+    def unpack(bufs):
+        offs = {d: 0 for d in dtypes}
+        bufs_np = {d: np.asarray(b) for d, b in zip(dtypes, bufs)}
+        out = []
+        for shape, d in spec:
+            n = int(np.prod(shape, dtype=np.int64))
+            out.append(bufs_np[d][offs[d]: offs[d] + n].reshape(shape))
+            offs[d] += n
+        return jax.tree.unflatten(treedef, out)
+
+    got = (jax.jit(pack), unpack)
+    if len(_PACK_CACHE) > 128:
+        _PACK_CACHE.clear()
+    _PACK_CACHE[key] = got
+    return got
+
+
 #: jitted cross-agent state merges, keyed by (layout_fp, arity) — a fresh
 #: jit per query would recompile the merge every time
 _GANG_MERGE_CACHE: dict = {}
@@ -878,7 +927,8 @@ def gang_merge_states(deferred: list) -> object:
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
                  mesh="auto", analyze: bool = False, udtf_ctx=None,
-                 otel_exporter=None, route_scale: int = 1):
+                 otel_exporter=None, route_scale: int = 1,
+                 force_backend: Optional[str] = None):
         from pixie_tpu.udf import registry as default_registry
 
         self.plan = plan
@@ -907,6 +957,11 @@ class PlanExecutor:
         #: CPU/TPU routing multiplies local input sizes by this so a sharded
         #: query routes by its TOTAL size (see _route_backend).
         self.route_scale = max(1, int(route_scale))
+        #: pin the dispatch backend regardless of input size.  The streaming
+        #: executor pins "cpu": every poll delta would re-UPLOAD its rows to
+        #: a remote TPU (hot data is host-resident), so size-based routing is
+        #: wrong for polls however large the delta.
+        self.force_backend = force_backend
         #: colocated-agent mode (LocalCluster): partial-agg channels return
         #: device-resident state (_DeferredPartial) instead of pulling — the
         #: cluster coalesces ALL agents' readbacks into ONE transfer wave.
@@ -923,6 +978,17 @@ class PlanExecutor:
 
             mesh = default_mesh()
         self.mesh = mesh
+
+    # ------------------------------------------------------------- routing
+    def _backend_for(self, src) -> str:
+        if self.force_backend is not None:
+            return self.force_backend
+        return _route_backend(src, self.route_scale)
+
+    def _device_ctx(self, src):
+        if self._backend_for(src) == "cpu" and _cpu_device() is not False:
+            return jax.default_device(_cpu_device())
+        return _contextlib.nullcontext()
 
     # -------------------------------------------------------------- exec stats
     @_contextlib.contextmanager
@@ -1260,15 +1326,13 @@ class PlanExecutor:
             # exactly two round-trips — one packed pull of the row counts, one
             # packed pull of the count-sliced outputs.  With a remote TPU each
             # readback costs a fixed RTT, so per-feed pulls would dominate.
-            with self._timed(label, op_ids) as rec, \
-                    _small_input_device(src, self.route_scale):
+            with self._timed(label, op_ids) as rec, self._device_ctx(src):
                 has_limit = kern.has_limit
                 remaining = kern.init_limits()
                 feeds = []
                 feed_ns = []
                 for cols, n_valid in self._feed(
-                        src, names, cap,
-                        backend=_route_backend(src, self.route_scale)):
+                        src, names, cap, backend=self._backend_for(src)):
                     tf0 = _time.perf_counter_ns()
                     outs, cnt, consumed = step(
                         cols, np.int64(n_valid), t_lo, t_hi, remaining, luts
@@ -1537,7 +1601,7 @@ class PlanExecutor:
             upd = jax.jit(upd, donate_argnums=(0,))
             _cache_put(_json.dumps(upd_key), (upd, udas))
         with self._timed(f"sorted_agg(by={op.groups}, G={G})", [op.id]), \
-                _small_input_device(hb, self.route_scale):
+                self._device_ctx(hb):
             # state init happens inside the device context so the donated
             # accumulators live on the dispatch device (CPU for small batches)
             state = {name: uda.init(Gb, in_dt)
@@ -1725,7 +1789,7 @@ class PlanExecutor:
             )
         # Small host-batch inputs dispatch on the CPU backend (compile is the
         # dominant cost at this scale); the SPMD path stays on the mesh.
-        dev_ctx = (_small_input_device(src, self.route_scale)
+        dev_ctx = (self._device_ctx(src)
                    if spmd_step is None else _contextlib.nullcontext())
         with dev_ctx:
             t_lo, t_hi = _time_bounds(head)
@@ -1733,12 +1797,14 @@ class PlanExecutor:
             with self._timed(
                 self._chain_label(head, chain, "partial_agg"),
                 ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
-            ):
+            ) as rec:
+                self._feed_rec = rec if self.analyze else None
                 state_np = self._agg_feed_loop(
                     kern, step, partial_step, merge_fn, spmd_step,
                     init_specs, num_groups,
                     src, names, cap, t_lo, t_hi, luts,
                 )
+                self._feed_rec = None
         return keys, udas, state_np, seen_name, in_types, val_dicts
 
     def _refresh_window_keys(self, keys, src, head):
@@ -1869,8 +1935,7 @@ class PlanExecutor:
                      for name, uda, in_dt in init_specs}
             remaining = kern.init_limits()
             for cols, n_valid in self._feed(
-                    src, names, cap,
-                    backend=_route_backend(src, self.route_scale)):
+                    src, names, cap, backend=self._backend_for(src)):
                 state, cnt, consumed = step(
                     cols, np.int64(n_valid), t_lo, t_hi, remaining, luts, state
                 )
@@ -1889,7 +1954,7 @@ class PlanExecutor:
             partials = []
             n_dev = self.mesh.size if self.mesh is not None else 1
             backend = ("tpu" if spmd_step is not None
-                       else _route_backend(src, self.route_scale))
+                       else self._backend_for(src))
             for cols, n_valid in self._feed(src, names, cap,
                                             spmd=spmd_step is not None,
                                             backend=backend):
@@ -1912,12 +1977,31 @@ class PlanExecutor:
                     ctx = (jax.default_device(_cpu_device()) if small_np
                            else _contextlib.nullcontext())
                     with ctx:
-                        partials.append(
-                            partial_step(cols, np.int64(n_valid), t_lo, t_hi,
-                                         luts)
-                        )
+                        p = partial_step(cols, np.int64(n_valid), t_lo,
+                                         t_hi, luts)
+                        if not small_np and backend == "tpu" \
+                                and not getattr(self, "_defer_active",
+                                                False):
+                            # pack the multi-leaf state into one buffer per
+                            # dtype (an extra async dispatch): each pulled
+                            # leaf costs a round trip on a tunneled runtime
+                            # (deferred partials stay raw — the gang merge
+                            # reduces leaf-wise)
+                            pk = _state_packer(p)
+                            if pk is not None:
+                                packer, unpack = pk
+                                p = _PackedState(packer(p), unpack)
+                    partials.append(p)
                 if self.analyze:
-                    jax.block_until_ready(partials[-1])
+                    tf0 = _time.perf_counter_ns()
+                    jax.block_until_ready(
+                        partials[-1].buf
+                        if isinstance(partials[-1], _PackedState)
+                        else partials[-1])
+                    rec = getattr(self, "_feed_rec", None)
+                    if rec is not None:
+                        rec.setdefault("feed_ns", []).append(
+                            _time.perf_counter_ns() - tf0)
             if partials:
                 # deferral is scoped to the distributed partial path
                 # (_partial_agg_batch) — the local finalize path reads the
@@ -1937,7 +2021,14 @@ class PlanExecutor:
                     if not dev:
                         return host_state
                     return _DeferredState(dev, merge_fn, host_state)
-                return merge_fn(*transfer.pull(partials))
+                pulled = transfer.pull(
+                    [p.buf if isinstance(p, _PackedState) else p
+                     for p in partials])
+                states = [
+                    p.unpack(buf) if isinstance(p, _PackedState) else buf
+                    for p, buf in zip(partials, pulled)
+                ]
+                return merge_fn(*states)
 
         if state is None:  # no feeds at all: identity state
             state = {name: uda.init(num_groups, in_dt)

@@ -204,12 +204,12 @@ class StreamQuery:
         )
 
     # ------------------------------------------------------------------- drive
-    #: per-poll delta cap.  An unbounded delta (a poller that fell behind a
-    #: fast writer) would cross the engine's CPU/TPU crossover and pay
-    #: fixed-cost device round-trips + a bulk host→device upload of hot
-    #: data, compounding the lag; bounded deltas stay on the fast path and
-    #: the caller just polls again (see lagging()).
-    MAX_POLL_ROWS = 1 << 22
+    #: per-poll delta cap.  Poll kernels are PINNED to the CPU backend
+    #: (PlanExecutor force_backend: hot rows are host-resident, so shipping
+    #: every delta to a remote TPU would pay a bulk upload per poll), so the
+    #: cap no longer needs to sit below the CPU/TPU crossover — it bounds
+    #: per-poll latency and amortizes the fixed per-poll dispatch cost.
+    MAX_POLL_ROWS = 1 << 23
 
     def poll(self) -> dict[str, QueryResult]:
         """Process rows appended since the last poll (up to MAX_POLL_ROWS per
@@ -294,7 +294,8 @@ class StreamQuery:
             # chain pipeline: patch carried limit budgets into this poll's run
             for lid in pl.limit_ids:
                 pl.fragment.op(lid).n = pl.remaining[lid]
-            ex = PlanExecutor(pl.fragment, self.store, self.registry)
+            ex = PlanExecutor(pl.fragment, self.store, self.registry,
+                              force_backend="cpu")
             res = ex.run()[pl.sink_name]
             pl.token = hi
             if pl.limit_ids:
@@ -346,7 +347,8 @@ class StreamQuery:
         """Run the partial agg fragment over this poll's row-id delta.
         Caller must have set pl.source.since/stop_row_id; advances the token
         on success.  Returns the delta PartialAggBatch."""
-        ex = PlanExecutor(pl.fragment, self.store, self.registry)
+        ex = PlanExecutor(pl.fragment, self.store, self.registry,
+                          force_backend="cpu")
         pb = ex.run_agent()[self.CHANNEL]
         pl.token = pl.source.stop_row_id
         return pb

@@ -76,6 +76,7 @@ class ElfReader:
         self._sections = self._read_sections()
         self._symbols: Optional[list[ElfSymbol]] = None
         self._by_addr: Optional[tuple[list[int], list[ElfSymbol]]] = None
+        self._min_load: Optional[int] = None
 
     # ------------------------------------------------------------- sections
     def _read_sections(self) -> list[dict]:
@@ -162,7 +163,11 @@ class ElfReader:
 
     # ---------------------------------------------------------- load bias
     def min_load_vaddr(self) -> int:
-        """Lowest PT_LOAD vaddr — the reference point for PIE bias."""
+        """Lowest PT_LOAD vaddr — the reference point for PIE bias.
+        Memoized: symbolize() consults it per frame on the profiler's
+        ingest path."""
+        if self._min_load is not None:
+            return self._min_load
         d = self.data
         fmt = (self._end + "IIQQQQQQ") if self.is64 else (self._end + "IIIIIIII")
         sz = struct.calcsize(fmt)
@@ -180,7 +185,8 @@ class ElfReader:
                     fmt, raw)
             if ptype == _PT_LOAD:
                 lo = vaddr if lo is None else min(lo, vaddr)
-        return lo or 0
+        self._min_load = lo or 0
+        return self._min_load
 
 
 class NativeSymbolizer:

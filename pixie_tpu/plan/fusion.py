@@ -14,7 +14,17 @@ from __future__ import annotations
 import copy
 import json
 
-from pixie_tpu.plan.plan import MemorySinkOp, Plan
+from pixie_tpu.plan.plan import (
+    AggOp,
+    FilterOp,
+    JoinOp,
+    LimitOp,
+    MapOp,
+    MemorySinkOp,
+    MemorySourceOp,
+    Plan,
+    UnionOp,
+)
 
 
 def merge_plans(named: list) -> tuple[Plan, dict]:
@@ -50,7 +60,205 @@ def merge_plans(named: list) -> tuple[Plan, dict]:
                 canon[key] = c
                 got = c
             local[op.id] = got
-    return fused, sink_map
+    # scan merging re-parents downstream ops, so re-run hash-consing to
+    # collapse the now-identical chains (filters over the merged scan)
+    # before looking for sibling aggs
+    fused = _dedup(_merge_pruned_scans(fused))
+    return _merge_sibling_aggs(fused), sink_map
+
+
+def _dedup(fused: Plan) -> Plan:
+    """One hash-consing pass over a single plan (sinks preserved as-is)."""
+    out = Plan()
+    canon: dict = {}
+    new_of: dict = {}
+    for op in fused.topo_sorted():
+        parents = [new_of[p.id] for p in fused.parents(op)]
+        if isinstance(op, MemorySinkOp):
+            c = copy.copy(op)
+            c.id = -1
+            out.add(c, parents=parents)
+            new_of[op.id] = c
+            continue
+        d = op.to_dict()
+        d.pop("id", None)
+        key = (json.dumps(d, sort_keys=True, default=str),
+               tuple(p.id for p in parents))
+        got = canon.get(key)
+        if got is None:
+            c = copy.copy(op)
+            c.id = -1
+            out.add(c, parents=parents)
+            canon[key] = c
+            got = c
+        new_of[op.id] = got
+    return out
+
+
+def _consumer_children(fused: Plan) -> dict:
+    children: dict[int, list] = {}
+    for op in fused.topo_sorted():
+        for p in fused.parents(op):
+            children.setdefault(p.id, []).append(op)
+    return children
+
+
+def _descendants_project(op, children: dict) -> bool:
+    """True if every transitive consumer selects columns EXPLICITLY, so
+    widening `op`'s output columns cannot leak into a full-schema consumer
+    (a Union branch or columns-less sink would change shape/crash)."""
+    stack = list(children.get(op.id, []))
+    while stack:
+        c = stack.pop()
+        if isinstance(c, UnionOp):
+            return False
+        if isinstance(c, JoinOp) and not c.output:
+            return False
+        if isinstance(c, MemorySinkOp):
+            if c.columns is None:
+                return False
+            continue  # sinks terminate the walk
+        if isinstance(c, MapOp):
+            continue  # explicit full output list: nothing leaks past it
+        if isinstance(c, (FilterOp, LimitOp, JoinOp, AggOp)):
+            stack.extend(children.get(c.id, []))
+            continue
+        return False  # unknown consumer: don't risk schema leaks
+    return True
+
+
+def _merge_pruned_scans(fused: Plan) -> Plan:
+    """Merge MemorySourceOps identical except for per-plan column pruning,
+    widening to the column UNION — guarded so the extra columns only flow
+    into consumers that project explicitly."""
+    children = _consumer_children(fused)
+    groups: dict[str, list] = {}
+    for op in fused.topo_sorted():
+        if not isinstance(op, MemorySourceOp):
+            continue
+        d = op.to_dict()
+        d.pop("id", None)
+        d.pop("columns", None)
+        groups.setdefault(json.dumps(d, sort_keys=True, default=str),
+                          []).append(op)
+    replace: dict[int, MemorySourceOp] = {}
+    for ops in groups.values():
+        if len(ops) < 2:
+            continue
+        if not all(_descendants_project(o, children) for o in ops):
+            continue
+        cols: list | None = []
+        for o in ops:
+            if o.columns is None:
+                cols = None
+                break
+            cols.extend(c for c in o.columns if c not in cols)
+        merged = copy.copy(ops[0])
+        merged.columns = cols
+        for o in ops:
+            replace[o.id] = merged
+    if not replace:
+        return fused
+    out = Plan()
+    new_of: dict = {}
+    added: dict = {}
+    for op in fused.topo_sorted():
+        parents = [new_of[p.id] for p in fused.parents(op)]
+        m = replace.get(op.id)
+        if m is not None:
+            got = added.get(id(m))
+            if got is None:
+                c = copy.copy(m)
+                c.id = -1
+                out.add(c, parents=parents)
+                added[id(m)] = c
+                got = c
+            new_of[op.id] = got
+            continue
+        c = copy.copy(op)
+        c.id = -1
+        out.add(c, parents=parents)
+        new_of[op.id] = c
+    return out
+
+
+def _merge_sibling_aggs(fused: Plan) -> Plan:
+    """Merge sibling AggOps sharing (parent, groups) into ONE multi-value
+    aggregate — two widgets computing different aggregates of the same
+    filtered scan then share a single device kernel pass (the deeper half of
+    the reference's MergeNodesRule: hash-consing only dedups IDENTICAL ops;
+    sibling aggs differ by value list yet still share all their input work).
+
+    Conservative guards: non-windowed single-parent aggs only; value
+    out_names must not collide with different (fn, arg); every descendant
+    must project columns explicitly (Map/Filter/Limit/sinks-with-columns/
+    joins-with-output), so the extra sibling columns never leak into a
+    full-schema consumer.
+    """
+    children = _consumer_children(fused)
+
+    def descendants_project(op) -> bool:
+        return _descendants_project(op, children)
+
+    sibs: dict[tuple, list] = {}
+    for op in fused.topo_sorted():
+        if not isinstance(op, AggOp) or op.windowed:
+            continue
+        ps = fused.parents(op)
+        if len(ps) != 1:
+            continue
+        key = (ps[0].id, tuple(op.groups), op.partial, op.finalize)
+        sibs.setdefault(key, []).append(op)
+
+    replace: dict[int, AggOp] = {}
+    for key, ops in sibs.items():
+        if len(ops) < 2 or not all(descendants_project(o) for o in ops):
+            continue
+        seen: dict = {}
+        vals = []
+        ok = True
+        for o in ops:
+            for ae in o.values:
+                prev = seen.get(ae.out_name)
+                if prev is None:
+                    seen[ae.out_name] = (ae.fn, ae.arg)
+                    vals.append(ae)
+                elif prev != (ae.fn, ae.arg):
+                    ok = False  # same name, different aggregate: bail
+                    break
+            if not ok:
+                break
+        if not ok:
+            continue
+        merged = AggOp(groups=list(ops[0].groups), values=vals,
+                       windowed=False, partial=ops[0].partial,
+                       finalize=ops[0].finalize)
+        for o in ops:
+            replace[o.id] = merged
+    if not replace:
+        return fused
+
+    out = Plan()
+    new_of: dict = {}
+    added: dict = {}
+    for op in fused.topo_sorted():
+        parents = [new_of[p.id] for p in fused.parents(op)]
+        m = replace.get(op.id)
+        if m is not None:
+            got = added.get(id(m))
+            if got is None:
+                c = copy.copy(m)
+                c.id = -1
+                out.add(c, parents=parents)
+                added[id(m)] = c
+                got = c
+            new_of[op.id] = got
+            continue
+        c = copy.copy(op)
+        c.id = -1
+        out.add(c, parents=parents)
+        new_of[op.id] = c
+    return out
 
 
 def fuse_compiled(queries: list):

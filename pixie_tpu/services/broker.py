@@ -314,6 +314,7 @@ class Broker:
                 now=meta.get("now"),
                 default_limit=meta.get("default_limit"),
                 analyze=bool(meta.get("analyze", False)),
+                funcs=[tuple(f) for f in meta.get("funcs") or []] or None,
             )
             for name, qr in results.items():
                 hb = HostBatch(
@@ -375,16 +376,22 @@ class Broker:
 
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
-        default_limit=None, analyze: bool = False,
+        default_limit=None, analyze: bool = False, funcs=None,
     ) -> tuple[dict[str, QueryResult], dict]:
-        """Compile + distribute + merge (the in-process core of ExecuteScript)."""
+        """Compile + distribute + merge (the in-process core of ExecuteScript).
+
+        `funcs=[(prefix, func_name, func_args)]` executes a MULTI-widget
+        request as ONE fused distributed query (shared scans/filters/aggs
+        run once — reference optimizer.h:39 MergeNodesRule); the returned
+        stats carry `sink_map` so the caller splits results per widget.
+        """
         from pixie_tpu import metrics as _metrics
 
         _metrics.counter_inc("px_broker_queries_total",
                              help_="ExecuteScript requests served")
         try:
             return self._execute_script_inner(
-                script, func, func_args, now, default_limit, analyze
+                script, func, func_args, now, default_limit, analyze, funcs
             )
         except Exception:
             _metrics.counter_inc("px_broker_query_errors_total",
@@ -393,19 +400,29 @@ class Broker:
 
     def _execute_script_inner(
         self, script, func, func_args, now, default_limit, analyze,
+        funcs=None,
     ) -> tuple[dict[str, QueryResult], dict]:
-        from pixie_tpu.compiler import compile_pxl
+        from pixie_tpu.compiler import compile_pxl, compile_pxl_funcs
         from pixie_tpu.parallel.cluster import _union_host_batches
         from pixie_tpu.status import Internal, Unavailable
 
         spec = self.registry.cluster_spec()
         if not any(a.has_data_store for a in spec.agents):
             raise Unavailable("no live data agents registered")
-        q = compile_pxl(
-            script, self.registry.combined_schemas(), func=func,
-            func_args=func_args, registry=self.udf_registry, now=now,
-            default_limit=default_limit,
-        )
+        sink_map = None
+        if funcs:
+            q, sink_map = compile_pxl_funcs(
+                script, self.registry.combined_schemas(),
+                [(p, f, a) for p, f, a in funcs],
+                registry=self.udf_registry, now=now,
+                default_limit=default_limit,
+            )
+        else:
+            q = compile_pxl(
+                script, self.registry.combined_schemas(), func=func,
+                func_args=func_args, registry=self.udf_registry, now=now,
+                default_limit=default_limit,
+            )
         if q.mutations:
             # Deploy tracepoints to every live agent and wait for readiness
             # (reference MutationExecutor: register → agents deploy → poll
@@ -493,6 +510,9 @@ class Broker:
             for r in results.values():
                 restamp_result(r, q.plan, sstore, reg)
             stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+            if sink_map is not None:
+                stats["sink_map"] = sink_map
+                stats["merger"]["operators"] = ex.op_stats
             for r in results.values():
                 r.exec_stats["agents"] = ctx.agent_stats
             return results, stats

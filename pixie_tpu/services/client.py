@@ -85,14 +85,18 @@ class Client:
     # --------------------------------------------------------------------- api
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
-        default_limit=None, analyze: bool = False,
+        default_limit=None, analyze: bool = False, funcs=None,
     ) -> dict[str, QueryResult]:
+        """funcs=[(prefix, func_name, func_args)] runs a multi-widget
+        request as ONE fused broker query; results key by fused sink name,
+        with exec_stats['sink_map'] mapping widget → sinks."""
         rid, p = self._new_pending()
         try:
             ok = self.conn.send(wire.encode_json({
                 "msg": "execute_script", "req_id": rid, "script": script,
                 "func": func, "func_args": func_args, "now": now,
                 "default_limit": default_limit, "analyze": analyze,
+                "funcs": [list(f) for f in funcs] if funcs else None,
             }))
             if not ok:
                 raise Unavailable("broker connection closed")
