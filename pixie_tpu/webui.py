@@ -1,0 +1,471 @@
+"""Live web view: script editor + widget grid served over HTTP.
+
+Reference: the Live View (src/ui/src/containers/live/) — per-script vis.json
+drives a widget grid (tables, timeseries, bars, flamegraphs, graphs), script
+source is editable and re-runnable in place, and entity names deep-link to
+drill-down scripts (script_reference semantics).  The reference is a 66K-LoC
+React app; this is the same user loop on the stdlib HTTP server with
+server-rendered widgets (inline SVG), which keeps the framework dependency-
+free and testable end-to-end.
+
+Serving modes: a local TableStore (demo / single agent) or any callable with
+the broker-runner signature — the CLI exposes `pixie ui`.
+"""
+from __future__ import annotations
+
+import html
+import json
+import pathlib
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from pixie_tpu.types import SemanticType as ST
+
+DEFAULT_SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
+
+#: entity semantic types → drill-down script + arg name (the reference's
+#: script_reference deep links, px/http_data/data.pxl add_source_dest_links)
+_ENTITY_LINKS = {
+    ST.ST_POD_NAME: ("pod", "pod"),
+    ST.ST_SERVICE_NAME: ("service", "service"),
+    ST.ST_NAMESPACE_NAME: ("namespace", "namespace"),
+    ST.ST_NODE_NAME: ("node", "node"),
+    ST.ST_IP_ADDRESS: ("ip", "ip"),
+}
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>{title} — pixie-tpu live</title>
+<style>
+body {{ font: 13px/1.45 system-ui, sans-serif; margin: 0; background: #101418; color: #e4e8ec; }}
+header {{ padding: 10px 16px; background: #161c22; border-bottom: 1px solid #2a333c; }}
+header a {{ color: #6cb6ff; text-decoration: none; margin-right: 14px; }}
+main {{ padding: 14px 16px; }}
+.vars label {{ margin-right: 14px; font-size: 12px; color: #9aa6b2; }}
+.vars input {{ background: #0c1014; color: #e4e8ec; border: 1px solid #2a333c; padding: 3px 6px; border-radius: 3px; }}
+button {{ background: #2563eb; color: #fff; border: 0; padding: 6px 16px; border-radius: 4px; cursor: pointer; }}
+textarea {{ width: 100%; min-height: 180px; background: #0c1014; color: #d3e0ea; border: 1px solid #2a333c; font: 12px/1.4 ui-monospace, monospace; padding: 8px; box-sizing: border-box; }}
+.grid {{ display: grid; grid-template-columns: repeat(auto-fit, minmax(430px, 1fr)); gap: 14px; margin-top: 14px; }}
+.widget {{ background: #161c22; border: 1px solid #2a333c; border-radius: 6px; padding: 10px 12px; overflow: auto; }}
+.widget h3 {{ margin: 0 0 8px; font-size: 13px; color: #9aa6b2; font-weight: 600; }}
+table {{ border-collapse: collapse; font-size: 12px; width: 100%; }}
+th, td {{ text-align: left; padding: 3px 8px; border-bottom: 1px solid #222a33; white-space: nowrap; }}
+th {{ color: #9aa6b2; position: sticky; top: 0; background: #161c22; }}
+td a {{ color: #6cb6ff; text-decoration: none; }}
+.flame div {{ font: 10px/1.6 ui-monospace, monospace; white-space: nowrap; overflow: hidden; border-radius: 2px; margin-top: 1px; padding: 0 3px; color: #10141a; }}
+.err {{ color: #ff7a7a; white-space: pre-wrap; }}
+#status {{ color: #9aa6b2; font-size: 12px; margin-left: 10px; }}
+svg text {{ fill: #9aa6b2; font-size: 10px; }}
+</style></head>
+<body>
+<header><a href="/">pixie-tpu live</a><b>{title}</b></header>
+<main>
+<form class="vars" id="vars" onsubmit="run(); return false;">{var_inputs}
+<button type="submit">Run</button><span id="status"></span></form>
+<details style="margin-top:10px"><summary style="cursor:pointer;color:#9aa6b2">script source (edit &amp; re-run)</summary>
+<textarea id="source">{source}</textarea></details>
+<div class="grid" id="grid"></div>
+</main>
+<script>
+async function run() {{
+  const st = document.getElementById('status');
+  st.textContent = 'running…';
+  const vars = {{}};
+  for (const el of document.querySelectorAll('.vars input')) vars[el.name] = el.value;
+  const body = {{script: {script_json}, vars, source: document.getElementById('source').value}};
+  const t0 = performance.now();
+  try {{
+    const r = await fetch('/api/run', {{method: 'POST', body: JSON.stringify(body)}});
+    const data = await r.json();
+    const grid = document.getElementById('grid');
+    grid.innerHTML = '';
+    if (data.error) {{ grid.innerHTML = '<div class="widget err">' + data.error + '</div>'; }}
+    for (const w of (data.widgets || [])) {{
+      const d = document.createElement('div');
+      d.className = 'widget';
+      d.innerHTML = '<h3>' + w.name + '</h3>' + w.html;
+      grid.appendChild(d);
+    }}
+    st.textContent = ((performance.now() - t0) | 0) + ' ms';
+  }} catch (e) {{ st.textContent = 'error: ' + e; }}
+}}
+run();
+</script>
+</body></html>"""
+
+_INDEX = """<!doctype html>
+<html><head><meta charset="utf-8"><title>pixie-tpu live</title>
+<style>body { font: 14px system-ui; margin: 24px; background: #101418; color: #e4e8ec; }
+a { color: #6cb6ff; text-decoration: none; display: inline-block; width: 240px; padding: 3px 0; }</style>
+</head><body><h2>pixie-tpu live — scripts</h2>%s</body></html>"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+# -------------------------------------------------------- widget renderers
+def _cell(val, fmt, link: Optional[tuple], extra_args: dict) -> str:
+    s = _esc(fmt(val) if fmt else val)
+    if link and val not in ("", "-", None):
+        script, arg = link
+        q = urllib.parse.urlencode({arg: val, **extra_args})
+        return f'<a href="/script/{script}?{q}">{s}</a>'
+    return s
+
+
+def table_html(result, max_rows: int = 200, link_args: Optional[dict] = None
+               ) -> str:
+    from pixie_tpu.cli import _formatter
+
+    names = result.relation.names()
+    n = min(result.num_rows, max_rows)
+    cols = {}
+    fmts = {}
+    links = {}
+    for name in names:
+        arr = result.columns[name][:n]
+        d = result.dictionaries.get(name)
+        cols[name] = d.decode(arr) if d is not None else arr.tolist()
+        cs = result.relation.col(name)
+        fmts[name] = _formatter(cs)
+        links[name] = _ENTITY_LINKS.get(cs.semantic_type)
+    head = "".join(f"<th>{_esc(c)}</th>" for c in names)
+    rows = []
+    for i in range(n):
+        tds = "".join(
+            f"<td>{_cell(cols[c][i], fmts[c], links[c], link_args or {})}</td>"
+            for c in names
+        )
+        rows.append(f"<tr>{tds}</tr>")
+    more = (f"<div style='color:#9aa6b2'>… {result.num_rows - n} more rows"
+            f"</div>" if result.num_rows > n else "")
+    return (f"<table><thead><tr>{head}</tr></thead>"
+            f"<tbody>{''.join(rows)}</tbody></table>{more}")
+
+
+_SERIES_COLORS = ["#6cb6ff", "#f6a343", "#51c995", "#e37fd2", "#a7a9fc",
+                  "#ffd166"]
+
+
+def timeseries_svg(result, display: dict, width: int = 420,
+                   height: int = 150) -> str:
+    """Inline-SVG line chart (reference TimeseriesChart widget)."""
+    ts_specs = display.get("timeseries") or [{}]
+    value_col = ts_specs[0].get("value")
+    series_col = ts_specs[0].get("series")
+    names = result.relation.names()
+    time_col = "time_" if "time_" in names else names[0]
+    if value_col is None:
+        value_col = next(
+            (c for c in names if c != time_col and c != series_col), None)
+    if value_col is None or result.num_rows == 0:
+        return "<div>(no data)</div>"
+    t = [float(v) for v in result.columns[time_col]]
+    y = [float(v) for v in result.columns[value_col]]
+    groups: dict = {}
+    if series_col and series_col in names:
+        d = result.dictionaries.get(series_col)
+        arr = result.columns[series_col]
+        svals = d.decode(arr) if d is not None else [str(v) for v in arr]
+        for tv, yv, sv in zip(t, y, svals):
+            groups.setdefault(sv, []).append((tv, yv))
+    else:
+        groups[value_col] = list(zip(t, y))
+    t0, t1 = min(t), max(t) or 1
+    y0, y1 = min(y + [0.0]), max(y) or 1
+    spant, spany = (t1 - t0) or 1, (y1 - y0) or 1
+    polys = []
+    legend = []
+    for i, (name, pts) in enumerate(sorted(groups.items())[:6]):
+        pts.sort()
+        color = _SERIES_COLORS[i % len(_SERIES_COLORS)]
+        path = " ".join(
+            f"{(tv - t0) / spant * (width - 10) + 5:.1f},"
+            f"{height - 18 - (yv - y0) / spany * (height - 30):.1f}"
+            for tv, yv in pts
+        )
+        polys.append(f'<polyline fill="none" stroke="{color}" '
+                     f'stroke-width="1.5" points="{path}"/>')
+        legend.append(f'<tspan fill="{color}">● {_esc(name)}  </tspan>')
+    return (f'<svg viewBox="0 0 {width} {height}" width="100%">'
+            f"{''.join(polys)}"
+            f'<text x="5" y="{height - 4}">{"".join(legend)}'
+            f"</text></svg>")
+
+
+def bars_svg(result, display: dict, width: int = 420) -> str:
+    """Inline-SVG horizontal bar chart (reference BarChart widget)."""
+    from pixie_tpu.cli import _formatter
+
+    bar = display.get("bar", {})
+    label_col = bar.get("label")
+    value_col = bar.get("value")
+    names = result.relation.names()
+    if label_col is None:
+        label_col = next((c for c in names
+                          if c in result.dictionaries), names[0])
+    if value_col is None:
+        value_col = next((c for c in names if c != label_col), None)
+    if value_col is None or result.num_rows == 0:
+        return "<div>(no data)</div>"
+    d = result.dictionaries.get(label_col)
+    arr = result.columns[label_col]
+    labels = d.decode(arr) if d is not None else [str(v) for v in arr]
+    vals = [float(v) for v in result.columns[value_col]]
+    pairs = sorted(zip(labels, vals), key=lambda kv: -kv[1])[:12]
+    vmax = max((v for _l, v in pairs), default=1) or 1
+    fmt = _formatter(result.relation.col(value_col)) or (lambda v: f"{v:g}")
+    rows = []
+    bh = 16
+    for i, (label, v) in enumerate(pairs):
+        w = max(v / vmax * (width - 180), 1)
+        rows.append(
+            f'<text x="0" y="{i * (bh + 4) + 12}">{_esc(label)[:22]}</text>'
+            f'<rect x="150" y="{i * (bh + 4)}" width="{w:.1f}" height="{bh}"'
+            f' fill="#6cb6ff"/>'
+            f'<text x="{152 + w:.1f}" y="{i * (bh + 4) + 12}">'
+            f"{_esc(fmt(v))}</text>"
+        )
+    h = len(pairs) * (bh + 4) + 4
+    return f'<svg viewBox="0 0 {width} {h}" width="100%">{"".join(rows)}</svg>'
+
+
+def flamegraph_html(result, display: dict, max_depth: int = 24) -> str:
+    """Nested-div flamegraph (reference StackTraceFlameGraph widget)."""
+    spec = display.get("stacktraceFlameGraph", display.get("flamegraph", {}))
+    stack_col = spec.get("stacktraceColumn", "stack_trace")
+    count_col = spec.get("countColumn", "count")
+    names = result.relation.names()
+    if stack_col not in names or count_col not in names:
+        return table_html(result)
+    d = result.dictionaries.get(stack_col)
+    arr = result.columns[stack_col]
+    stacks = d.decode(arr) if d is not None else [str(v) for v in arr]
+    counts = [int(v) for v in result.columns[count_col]]
+    root: dict = {"n": "all", "c": 0, "ch": {}}
+    for s, c in zip(stacks, counts):
+        root["c"] += c
+        node = root
+        for frame in s.split(";")[:max_depth]:
+            node = node["ch"].setdefault(frame, {"n": frame, "c": 0, "ch": {}})
+            node["c"] += c
+    total = root["c"] or 1
+    palette = ["#f6a343", "#e8863c", "#ffd166", "#f09d51"]
+    out = []
+
+    def walk(node, depth):
+        if depth > max_depth:
+            return
+        kids = sorted(node["ch"].values(), key=lambda k: -k["c"])
+        for k in kids:
+            pct = k["c"] / total * 100
+            if pct < 0.5:
+                continue
+            color = palette[depth % len(palette)]
+            out.append(
+                f'<div style="width:{pct:.1f}%;background:{color};'
+                f'margin-left:{depth * 6}px" title="{_esc(k["n"])} '
+                f'({k["c"]})">{_esc(k["n"])}</div>'
+            )
+            walk(k, depth + 1)
+
+    walk(root, 0)
+    return f'<div class="flame">{"".join(out)}</div>'
+
+
+def render_widget_html(kind: str, display: dict, result,
+                       link_args: Optional[dict] = None) -> str:
+    if result.num_rows == 0:
+        return "<div style='color:#9aa6b2'>(no rows)</div>"
+    if kind == "TimeseriesChart":
+        return timeseries_svg(result, display)
+    if kind in ("BarChart", "HistogramChart"):
+        return bars_svg(result, display)
+    if kind == "StackTraceFlameGraph":
+        return flamegraph_html(result, display)
+    return table_html(result, link_args=link_args)
+
+
+# --------------------------------------------------------------- the server
+class LiveServer:
+    """Serve the live view.
+
+    runner(source, funcs) -> {sink_name: QueryResult} where funcs is
+    [(prefix, func_name, args)] (fused execution) or None (module script).
+    """
+
+    def __init__(self, runner: Callable, scripts_dir=DEFAULT_SCRIPTS,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.runner = runner
+        self.scripts_dir = pathlib.Path(scripts_dir)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, body: str, ctype="text/html", code=200):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", f"{ctype}; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path in ("", "/"):
+                    return self._send(outer.index_page())
+                if parsed.path.startswith("/script/"):
+                    name = parsed.path[len("/script/"):]
+                    qs = dict(urllib.parse.parse_qsl(parsed.query))
+                    try:
+                        return self._send(outer.script_page(name, qs))
+                    except FileNotFoundError:
+                        return self._send("not found", code=404)
+                return self._send("not found", code=404)
+
+            def do_POST(self):
+                if self.path != "/api/run":
+                    return self._send("not found", code=404)
+                ln = int(self.headers.get("Content-Length", 0))
+                try:
+                    req = json.loads(self.rfile.read(ln) or b"{}")
+                    out = outer.run_api(req)
+                except Exception as e:  # surface to the page, not the socket
+                    out = {"error": f"{type(e).__name__}: {e}"}
+                return self._send(json.dumps(out), ctype="application/json")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "LiveServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="pixie-webui")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ----------------------------------------------------------------- pages
+    def _script_names(self) -> list[str]:
+        return sorted(
+            d.name for d in self.scripts_dir.iterdir()
+            if d.is_dir() and list(d.glob("*.pxl"))
+        )
+
+    def index_page(self) -> str:
+        links = "".join(
+            f'<a href="/script/{n}">{_esc(n)}</a>' for n in self._script_names()
+        )
+        return _INDEX % links
+
+    def _load(self, name: str):
+        d = self.scripts_dir / name
+        pxls = sorted(d.glob("*.pxl"))
+        if not pxls:
+            raise FileNotFoundError(name)
+        source = pxls[0].read_text()
+        from pixie_tpu.vis import parse_vis
+
+        vis_path = d / "vis.json"
+        vis = parse_vis(json.loads(vis_path.read_text())) \
+            if vis_path.exists() else parse_vis({})
+        return source, vis
+
+    def script_page(self, name: str, overrides: dict) -> str:
+        source, vis = self._load(name)
+        values = vis.variable_values(overrides)
+        var_inputs = "".join(
+            f'<label>{_esc(v.name)} <input name="{_esc(v.name)}" '
+            f'value="{_esc(values.get(v.name, ""))}"></label>'
+            for v in vis.variables
+        )
+        return _PAGE.format(
+            title=_esc(name), var_inputs=var_inputs,
+            source=_esc(source), script_json=json.dumps(name),
+        )
+
+    # ------------------------------------------------------------------- api
+    def run_api(self, req: dict) -> dict:
+        name = req.get("script", "")
+        overrides = req.get("vars") or {}
+        source, vis = self._load(name)
+        if req.get("source"):
+            source = req["source"]
+        runs = vis.executions(overrides)
+        displays = vis.widget_displays()
+        link_args = {
+            k: v for k, v in vis.variable_values(overrides).items()
+            if k in ("start_time",)
+        }
+        widgets = []
+        if runs:
+            funcs = [(out_name, fn, args) for out_name, fn, args in runs]
+            results, sink_map = self.runner(source, funcs)
+            for out_name, _fn, _args in runs:
+                w = displays.get(out_name)
+                kind = w.kind if w else "table"
+                display = w.display if w else {}
+                for _orig, fused_name in sink_map.get(out_name, {}).items():
+                    res = results.get(fused_name)
+                    if res is None:
+                        continue
+                    widgets.append({
+                        "name": out_name, "kind": kind,
+                        "html": render_widget_html(kind, display, res,
+                                                   link_args),
+                    })
+        else:
+            results, _ = self.runner(source, None)
+            for sink, res in results.items():
+                widgets.append({
+                    "name": sink, "kind": "table",
+                    "html": table_html(res, link_args=link_args),
+                })
+        return {"widgets": widgets}
+
+
+# ---------------------------------------------------------------- runners
+def local_runner(store, registry=None, now=None):
+    """Runner over an in-process TableStore (fused multi-widget execution)."""
+    from pixie_tpu.compiler import compile_pxl, compile_pxl_funcs
+    from pixie_tpu.engine import execute_plan
+
+    def run(source, funcs):
+        from pixie_tpu.collect.schemas import all_schemas
+
+        schemas = dict(all_schemas())
+        schemas.update(store.schemas())
+        if funcs:
+            q, sink_map = compile_pxl_funcs(source, schemas, funcs,
+                                            registry=registry, now=now)
+            return execute_plan(q.plan, store), sink_map
+        q = compile_pxl(source, schemas, registry=registry, now=now)
+        results = execute_plan(q.plan, store)
+        return results, {s: {s: s} for s in results}
+
+    return run
+
+
+def broker_runner(client):
+    """Runner over a broker Client (fused distributed execution)."""
+
+    def run(source, funcs):
+        if funcs:
+            results = client.execute_script(source, funcs=funcs)
+            stats = next(iter(results.values())).exec_stats \
+                if results else {}
+            sink_map = stats.get("sink_map") or {}
+            return results, sink_map
+        results = client.execute_script(source)
+        return results, {s: {s: s} for s in results}
+
+    return run
