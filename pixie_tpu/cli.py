@@ -273,6 +273,30 @@ def cmd_broker(args) -> int:
     return 0
 
 
+def cmd_ui(args) -> int:
+    """Serve the Live View (reference src/ui Live View, server-rendered)."""
+    from pixie_tpu.webui import LiveServer, broker_runner, local_runner
+
+    if args.broker:
+        from pixie_tpu.services.client import Client
+
+        host, port = args.broker.rsplit(":", 1)
+        runner = broker_runner(Client(host, int(port),
+                                      auth_token=args.auth_token))
+    else:
+        store, now = _demo_cluster()
+        runner = local_runner(store, now=now)
+    server = LiveServer(runner, scripts_dir=args.bundle,
+                        host=args.host, port=args.port).start()
+    print(f"live view on http://{args.host}:{server.port}/", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
 def cmd_agent(args) -> int:
     from pixie_tpu.services.agent import main as agent_main
 
@@ -314,6 +338,16 @@ def main(argv=None) -> int:
     br.add_argument("--auth-token", default=None,
                     help="require this shared secret from every connection")
     br.set_defaults(fn=cmd_broker)
+
+    from pixie_tpu.webui import DEFAULT_SCRIPTS
+
+    ui = sub.add_parser("ui", help="serve the live web view")
+    ui.add_argument("--host", default="127.0.0.1")
+    ui.add_argument("--port", type=int, default=8083)
+    ui.add_argument("--bundle", default=str(DEFAULT_SCRIPTS))
+    ui.add_argument("--broker", help="host:port (default: in-process demo data)")
+    ui.add_argument("--auth-token", default=None)
+    ui.set_defaults(fn=cmd_ui)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)

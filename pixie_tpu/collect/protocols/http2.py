@@ -305,7 +305,10 @@ class HpackDecoder:
                 out.append((name, value))
             elif b & 0x20:  # §6.3 dynamic table size update
                 sz, pos = self._read_int(data, pos, 5)
-                self.max_size = sz
+                # Clamp: a corrupt/adversarial stream could raise max_size
+                # to 2^32 and grow the table unboundedly in a passive
+                # observer; rail it like MAX_FRAME_LEN rails frame lengths.
+                self.max_size = min(sz, 64 * 1024)
                 self._evict()
             else:  # §6.2.2/§6.2.3 literal without indexing / never indexed
                 idx, pos = self._read_int(data, pos, 4)

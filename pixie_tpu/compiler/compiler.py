@@ -266,8 +266,18 @@ def compile_pxl(
         # same-named sinks would silently shadow one another in results).
         sunk = {id(p) for s in ctx.sinks for p in ctx.plan.parents(s)}
         names = {getattr(s, "name", None) for s in ctx.sinks}
-        if id(result_df._node) not in sunk and "output" not in names:
-            result_df.display("output")
+        if id(result_df._node) not in sunk:
+            if "output" not in names:
+                result_df.display("output")
+            else:
+                # The script already claimed "output" for a DIFFERENT frame.
+                # Dropping the returned frame would silently lose the
+                # widget's table and mask a script bug — emit it under a
+                # deterministic fallback name instead.
+                i = 1
+                while f"output_{i}" in names:
+                    i += 1
+                result_df.display(f"output_{i}")
     if not ctx.sinks:
         raise CompilerError(
             "script produced no output: call px.display(df, name) or return a DataFrame"

@@ -119,15 +119,34 @@ def validate_program(program: str, probe_kind: str) -> None:
                 f"pxtrace printf: format has {nspec} specs but "
                 f"{nargs} arguments")
 
-    # $var def-before-use, per probe body scan order (string-stripped text)
-    assigned: set[str] = set()
-    for stmt in re.split(r"[;{}]", stripped):
-        for name in _ASSIGN_RE.findall(stmt):
-            assigned.add(name)
-        for name in _VARREF_RE.findall(stmt):
-            if name not in assigned and name not in _BUILTINS:
-                raise CompilerError(
-                    f"pxtrace: ${name} referenced before assignment")
+    # $var def-before-use.  bpftrace scratch variables are PROBE-scoped —
+    # a $var assigned only in probe A must not validate a use in probe B —
+    # so split the program into probe bodies first and scan each with a
+    # fresh assignment set (bpftrace reference manual, scratch variables).
+    starts = [m.start() for m in _PROBE_DECL_RE.finditer(stripped)]
+    bodies = []
+    if starts:
+        # text before the first declaration (BEGIN/END blocks, map setup)
+        # still gets scanned; each probe's slice runs from its OWN
+        # declaration start (so its /predicate/ $vars are checked under its
+        # scope — predicates evaluate before the body, hence before any
+        # assignment) to the next declaration's start.
+        if stripped[:starts[0]].strip():
+            bodies.append(stripped[:starts[0]])
+        for i, s in enumerate(starts):
+            nxt = starts[i + 1] if i + 1 < len(starts) else len(stripped)
+            bodies.append(stripped[s:nxt])
+    else:
+        bodies = [stripped]
+    for body in bodies:
+        assigned: set[str] = set()
+        for stmt in re.split(r"[;{}]", body):
+            for name in _ASSIGN_RE.findall(stmt):
+                assigned.add(name)
+            for name in _VARREF_RE.findall(stmt):
+                if name not in assigned and name not in _BUILTINS:
+                    raise CompilerError(
+                        f"pxtrace: ${name} referenced before assignment")
 
     # uprobe symbol resolution against the local binary (when readable)
     for kind, target in decls:

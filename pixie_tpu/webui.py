@@ -16,6 +16,7 @@ from __future__ import annotations
 import html
 import json
 import pathlib
+import secrets
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -76,7 +77,9 @@ async function run() {{
   const body = {{script: {script_json}, vars, source: document.getElementById('source').value}};
   const t0 = performance.now();
   try {{
-    const r = await fetch('/api/run', {{method: 'POST', body: JSON.stringify(body)}});
+    const r = await fetch('/api/run', {{method: 'POST',
+      headers: {{'X-Pixie-Session': {session_token}}},
+      body: JSON.stringify(body)}});
     const data = await r.json();
     const grid = document.getElementById('grid');
     grid.innerHTML = '';
@@ -300,11 +303,26 @@ class LiveServer:
                  host: str = "127.0.0.1", port: int = 0):
         self.runner = runner
         self.scripts_dir = pathlib.Path(scripts_dir)
+        # Per-session token embedded in served pages; POST /api/run requires
+        # it, so a drive-by cross-origin page (which cannot read our HTML)
+        # cannot trigger script execution or tracepoint mutations.  The
+        # reference UI sits behind cloud auth (src/ui auth flow).
+        self.session_token = secrets.token_urlsafe(16)
+        self._host = host
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
+
+            def _host_ok(self) -> bool:
+                # DNS-rebinding defense: a page at evil.com rebound to
+                # 127.0.0.1 reaches us with Host: evil.com — reject any
+                # Host that is not our own address (localhost variants ok).
+                hdr = self.headers.get("Host", "")
+                hostname = hdr.rsplit(":", 1)[0] if ":" in hdr else hdr
+                return hostname in ("127.0.0.1", "localhost", "::1",
+                                    "[::1]", outer._host)
 
             def _send(self, body: str, ctype="text/html", code=200):
                 data = body.encode()
@@ -315,6 +333,8 @@ class LiveServer:
                 self.wfile.write(data)
 
             def do_GET(self):
+                if not self._host_ok():
+                    return self._send("forbidden host", code=403)
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path in ("", "/"):
                     return self._send(outer.index_page())
@@ -328,8 +348,22 @@ class LiveServer:
                 return self._send("not found", code=404)
 
             def do_POST(self):
+                if not self._host_ok():
+                    return self._send("forbidden host", code=403)
                 if self.path != "/api/run":
                     return self._send("not found", code=404)
+                token = self.headers.get("X-Pixie-Session", "")
+                if not secrets.compare_digest(token, outer.session_token):
+                    return self._send(
+                        json.dumps({"error": "missing/invalid session token"}),
+                        ctype="application/json", code=403)
+                origin = self.headers.get("Origin")
+                if origin:
+                    ohost = urllib.parse.urlparse(origin).netloc
+                    if ohost != self.headers.get("Host", ""):
+                        return self._send(
+                            json.dumps({"error": "cross-origin rejected"}),
+                            ctype="application/json", code=403)
                 ln = int(self.headers.get("Content-Length", 0))
                 try:
                     req = json.loads(self.rfile.read(ln) or b"{}")
@@ -391,6 +425,7 @@ class LiveServer:
         return _PAGE.format(
             title=_esc(name), var_inputs=var_inputs,
             source=_esc(source), script_json=json.dumps(name),
+            session_token=json.dumps(self.session_token),
         )
 
     # ------------------------------------------------------------------- api

@@ -1,0 +1,99 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md).
+
+Each test pins the fixed behavior; webui session auth is covered in
+tests/test_webui.py (test_run_api_rejects_missing_token / _cross_origin).
+"""
+import pytest
+
+from pixie_tpu.collect.protocols.http2 import HpackDecoder
+from pixie_tpu.compiler.pxtrace import validate_program
+from pixie_tpu.status import CompilerError
+
+
+def _size_update_block(sz: int) -> bytes:
+    """Encode an HPACK §6.3 dynamic-table size update of `sz`."""
+    if sz < 31:
+        return bytes([0x20 | sz])
+    out = [0x20 | 31]
+    sz -= 31
+    while sz >= 128:
+        out.append((sz & 0x7F) | 0x80)
+        sz >>= 7
+    out.append(sz)
+    return bytes(out)
+
+
+def test_hpack_size_update_clamped():
+    """An adversarial 2^32 size update must not unbound the dynamic table."""
+    dec = HpackDecoder()
+    dec.decode(_size_update_block(2**32 - 1))
+    assert dec.max_size <= 64 * 1024
+    # and the decoder still works after the clamp
+    out = dec.decode(bytes([0x82]))  # indexed :method GET
+    assert out == [(":method", "GET")]
+
+
+def test_hpack_size_update_small_still_applies():
+    dec = HpackDecoder()
+    dec.decode(_size_update_block(128))
+    assert dec.max_size == 128
+
+
+def test_pxtrace_var_scope_is_per_probe():
+    """$var assigned only in probe A must not validate a use in probe B
+    (bpftrace scratch variables are probe-scoped)."""
+    bad = (
+        'kprobe:tcp_sendmsg { $sz = arg2; }\n'
+        'kprobe:tcp_recvmsg { printf("%d", $sz); }\n'
+    )
+    with pytest.raises(CompilerError, match=r"\$sz referenced before"):
+        validate_program(bad, "kprobe")
+
+
+def test_pxtrace_var_defined_in_same_probe_ok():
+    good = (
+        'kprobe:tcp_sendmsg { $sz = arg2; printf("%d", $sz); }\n'
+        'kprobe:tcp_recvmsg { $n = arg2; printf("%d", $n); }\n'
+    )
+    validate_program(good, "kprobe")  # must not raise
+
+
+def test_pxtrace_begin_block_vars_still_checked():
+    """Text before the first probe declaration (BEGIN blocks) must still be
+    scanned — an unset $var there must fail at compile, not attach."""
+    bad = (
+        'BEGIN { printf("%d", $unset); }\n'
+        'kprobe:tcp_sendmsg { printf("%d", pid); }\n'
+    )
+    with pytest.raises(CompilerError, match=r"\$unset referenced before"):
+        validate_program(bad, "kprobe")
+
+
+def test_pxtrace_next_probe_predicate_not_scanned_under_prior_body():
+    """A $var in probe B's /predicate/ must be validated against B's own
+    assignments, not leak into probe A's scan region."""
+    bad = (
+        'kprobe:tcp_sendmsg { $sz = arg2; printf("%d", $sz); }\n'
+        'kprobe:tcp_recvmsg /$sz > 0/ { printf("%d", pid); }\n'
+    )
+    with pytest.raises(CompilerError, match=r"\$sz referenced before"):
+        validate_program(bad, "kprobe")
+
+
+def test_vis_func_return_emitted_under_fallback_on_collision():
+    """A vis func whose 'output' name is taken by a DIFFERENT frame must
+    still emit its returned frame (under output_1), not silently drop it."""
+    from pixie_tpu.collect.schemas import all_schemas
+    from pixie_tpu.compiler import compile_pxl
+
+    src = (
+        "import px\n"
+        "def f():\n"
+        "    other = px.DataFrame(table='http_events', start_time='-5m')\n"
+        "    px.display(other, 'output')\n"
+        "    df = px.DataFrame(table='http_events', start_time='-5m')\n"
+        "    return df.groupby('req_path').agg(n=('latency', px.count))\n"
+    )
+    q = compile_pxl(src, all_schemas(), func="f")
+    assert "output" in q.sink_names
+    assert "output_1" in q.sink_names
