@@ -97,6 +97,10 @@ _ALLOWED_PXL_NODES = frozenset(
 _ALLOWED_UNDERSCORE_ATTRS = frozenset({
     "_exec_hostname", "_exec_host_num_cpus",
     "_match_regex_rule", "_match_endpoint",
+    # reference-named ML funcs (ml_ops.cc, request_path_ops.cc)
+    "_kmeans_fit", "_kmeans_inference", "_build_request_path_clusters",
+    "_predict_request_path_cluster", "_text_embedding",
+    "_encode_sentence_piece",
 })
 
 

@@ -29,6 +29,9 @@ _AGG_NAMES = (
     "any",
     "sample",
     "count_distinct",
+    # model-fit aggregates (reference ml_ops.cc:38, request_path_ops.cc:40)
+    "_kmeans_fit",
+    "_build_request_path_clusters",
 ) + tuple(f"p{q:02d}" for q in (1, 10, 25, 50, 75, 90, 95, 99))
 
 

@@ -58,7 +58,10 @@ def parse_program_schema(program: str) -> Relation:
 
 
 _PROBE_DECL_RE = re.compile(
-    r"^\s*(kprobe|kretprobe|uprobe|uretprobe|tracepoint|usdt|k|kr|u|ur|t)"
+    # a declaration may start a line OR follow a closing `}`/`;` on the same
+    # line ('kprobe:a { } kprobe:b { }' is two probes, two scopes)
+    r"(?:^|(?<=[;}]))\s*(kprobe|kretprobe|uprobe|uretprobe|tracepoint|usdt"
+    r"|k|kr|u|ur|t)"
     r":([^\s{]+)\s*(?:/[^/]*/\s*)?\{", re.M)
 _ASSIGN_RE = re.compile(r"\$([A-Za-z_][A-Za-z_0-9]*)\s*=[^=]")
 _VARREF_RE = re.compile(r"\$([A-Za-z_][A-Za-z_0-9]*)")

@@ -1565,6 +1565,12 @@ class PlanExecutor:
                     val_dicts[ae.out_name] = out_dicts[ae.arg]
                     dict_val_cols.add(ae.arg)
                 else:
+                    if getattr(uda, "needs_dict", False):
+                        raise Unimplemented(
+                            f"aggregate {ae.fn} requires a string "
+                            f"(dictionary-encoded) input column, got "
+                            f"{ae.arg!r}"
+                        )
                     in_types[ae.out_name] = out_dtypes[ae.arg]
                     in_dt = STORAGE_DTYPE[out_dtypes[ae.arg]]
             elif not uda.nullary:
@@ -1632,10 +1638,16 @@ class PlanExecutor:
             if g in in_dicts:
                 dicts[g] = in_dicts[g]
         for out_name, uda, _vn in udas:
-            full = uda.finalize_host(state_np[out_name])
+            if getattr(uda, "needs_dict", False):
+                # model-fit UDA: finalize over the input DICTIONARY (unique
+                # values + multiplicities), emitting fresh strings
+                full = uda.finalize_dict(state_np[out_name],
+                                         val_dicts[out_name])
+            else:
+                full = uda.finalize_host(state_np[out_name])
             vals = np.asarray(full)[:G]
             out_dt = uda.out_type(in_types[out_name]) if not uda.nullary else uda.out_type(None)
-            if out_name in val_dicts:
+            if out_name in val_dicts and not getattr(uda, "needs_dict", False):
                 cols[out_name] = _decode_picker_codes(vals, val_dicts[out_name])
                 dicts[out_name] = val_dicts[out_name]
                 dtypes[out_name] = out_dt
@@ -1883,6 +1895,12 @@ class PlanExecutor:
                     in_types[ae.out_name] = sv.dtype
                     val_dicts[ae.out_name] = sv.dictionary
                 else:
+                    if getattr(uda, "needs_dict", False):
+                        raise Unimplemented(
+                            f"aggregate {ae.fn} requires a string "
+                            f"(dictionary-encoded) input column, got "
+                            f"{ae.arg!r}"
+                        )
                     vb = sv.build
                     in_dtype = STORAGE_DTYPE[sv.dtype]
                     in_types[ae.out_name] = sv.dtype
@@ -2216,7 +2234,13 @@ class PlanExecutor:
         for out_name, uda, _vb in udas:
             if out_name == seen_name:
                 continue
-            full = uda.finalize_host(jax.tree.map(lambda x: x, state_np[out_name]))
+            if getattr(uda, "needs_dict", False):
+                full = uda.finalize_dict(
+                    jax.tree.map(lambda x: x, state_np[out_name]),
+                    val_dicts[out_name])
+            else:
+                full = uda.finalize_host(
+                    jax.tree.map(lambda x: x, state_np[out_name]))
             vals = np.asarray(full)[gids]
             # Use the DECLARED input DataType so e.g. min(time_) stays TIME64NS
             # (matching the compile-time schema); fall back to array inference
@@ -2227,7 +2251,8 @@ class PlanExecutor:
                 out_dt = uda.out_type(in_types[out_name])
             else:
                 out_dt = uda.out_type(_dtype_of(full))
-            if val_dicts and out_name in val_dicts:
+            if (val_dicts and out_name in val_dicts
+                    and not getattr(uda, "needs_dict", False)):
                 # dict-valued picker: the state holds CODES; out-of-range
                 # (all-null group sentinel) decodes to null
                 cols[out_name] = _decode_picker_codes(vals, val_dicts[out_name])

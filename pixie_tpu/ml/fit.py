@@ -1,0 +1,82 @@
+"""Model-fitting aggregates (`_kmeans_fit`, `_build_request_path_clusters`).
+
+Reference: src/carnot/funcs/builtins/ml_ops.cc:38 (KMeansUDA over a
+64-point streaming coreset) and request_path_ops.cc:40
+(RequestPathClusteringFitUDA) — UDAs whose Update consumes rows, whose
+Merge combines model state, and whose Finalize serializes a model JSON
+consumed by the matching inference scalar UDFs.
+
+TPU redesign (see udf.udf.DictHistUDA): the device-side state is a bounded
+per-group histogram of dictionary codes — "add"-mergeable so partial
+aggregation and collective merges hold by construction — and the actual
+model fit runs ONCE at finalize over the unique observed values with
+multiplicities.  That turns the reference's per-row C++ Update calls into a
+segment reduction plus an O(unique) host fit, which is the right shape for
+a dictionary-encoded columnar engine.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from pixie_tpu import flags
+from pixie_tpu.udf.udf import DictHistUDA
+
+flags.define_int("PX_KMEANS_K", 8,
+                 "default k for the _kmeans_fit aggregate (the reference "
+                 "passes k per Update call, ml_ops.h KMeansUDA)")
+
+
+class RequestPathClusteringFitUDA(DictHistUDA):
+    """`_build_request_path_clusters`: req_path column → endpoint-cluster
+    model JSON `[{"template": "/a/*/c"}, ...]`, consumed by
+    `_predict_request_path_cluster` (usage:
+    pxbeta/service_endpoints/service_endpoints.pxl:126)."""
+
+    name = "_build_request_path_clusters"
+
+    def fit_group(self, values, weights):
+        from pixie_tpu.ml.request_path import RequestPathClustering
+
+        paths = [v for v in values if v is not None]
+        model = RequestPathClustering().fit(paths)
+        return json.dumps([{"template": t} for t in model.templates])
+
+
+class KMeansFitUDA(DictHistUDA):
+    """`_kmeans_fit`: embedding-JSON column → kmeans model JSON
+    `{"centroids": [[...], ...]}`, consumed by `_kmeans_inference`
+    (reference ml_ops.h KMeansUDA; its second Update arg `k` is bound at
+    construction here — default from PL_KMEANS_K — since the histogram
+    state carries values, not per-row parameters)."""
+
+    name = "_kmeans_fit"
+
+    def __init__(self, k: int | None = None):
+        self.k = int(flags.get("PX_KMEANS_K") if k is None else k)
+
+    def fit_group(self, values, weights):
+        from pixie_tpu.ml.kmeans import kmeans_fit
+
+        pts, w = [], []
+        for v, c in zip(values, np.asarray(weights, dtype=np.float64)):
+            try:
+                x = json.loads(v)
+            except (TypeError, ValueError):
+                continue
+            if (isinstance(x, list) and x
+                    and all(isinstance(f, (int, float)) for f in x)):
+                pts.append([float(f) for f in x])
+                w.append(c)
+        if not pts:
+            return json.dumps({"centroids": []})
+        d = max(len(p) for p in pts)
+        pts = [p + [0.0] * (d - len(p)) for p in pts]
+        k = min(self.k, len(pts))
+        centers, _assign = kmeans_fit(
+            np.asarray(pts, dtype=np.float32), k,
+            weights=np.asarray(w, dtype=np.float32))
+        return json.dumps(
+            {"centroids": np.round(np.asarray(centers, dtype=np.float64),
+                                   6).tolist()})

@@ -90,6 +90,11 @@ def register_request_path_funcs(registry) -> None:
         out_type=DT.BOOLEAN, device=False,
         fn=lambda path, tmpl: _match(templatize(path), tmpl),
     ))
+    from pixie_tpu.ml.fit import KMeansFitUDA, RequestPathClusteringFitUDA
+
+    registry.register_uda("_build_request_path_clusters",
+                          RequestPathClusteringFitUDA)
+    registry.register_uda("_kmeans_fit", KMeansFitUDA)
 
 
 def _match(t: str, tmpl: str) -> bool:

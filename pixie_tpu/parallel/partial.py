@@ -19,7 +19,7 @@ import numpy as np
 
 from pixie_tpu.engine.executor import HostBatch
 from pixie_tpu.plan.plan import AggOp
-from pixie_tpu.status import InvalidArgument
+from pixie_tpu.status import Internal, InvalidArgument
 from pixie_tpu.table.dictionary import Dictionary
 from pixie_tpu.types import STORAGE_DTYPE, DataType as DT
 
@@ -203,6 +203,12 @@ def finalize_partial(
             )
     for ae in agg.values:
         uda = registry.uda(ae.fn)
+        if getattr(uda, "needs_dict", False):
+            # unreachable by plan construction: dict-input aggregates ship
+            # ROWS across agents (distributed.py), never partial state
+            raise Internal(
+                f"UDA {ae.fn} needs its input dictionary; partial-state "
+                "channels cannot carry dict-input aggregates")
         # finalize_host is host-pure by contract (no instance state from
         # init) — calling uda.init here would dispatch a device op with a
         # poll-varying group-count shape, i.e. a fresh XLA compile per poll.

@@ -90,6 +90,10 @@ class UDA:
     #: True if the aggregate's output keeps the input column's semantic type
     #: (min/mean/p50 of durations are durations; count of anything is not)
     st_preserve: bool = False
+    #: True if finalize needs the input column's Dictionary (model-fit UDAs);
+    #: the executor calls finalize_dict(state, dictionary) instead of
+    #: finalize_host (see DictHistUDA)
+    needs_dict: bool = False
     #: fixed output semantic type (e.g. quantiles → ST_QUANTILES), or None
     out_st = None
 
@@ -322,6 +326,65 @@ class AnyUDA(UDA):
 
     def finalize_host(self, state_np):
         return np.asarray(state_np)
+
+
+class DictHistUDA(UDA):
+    """Base for aggregates over a dictionary-encoded column whose FINALIZE
+    needs the string values (model-fitting UDAs: kmeans, request-path
+    clustering — reference funcs/builtins/ml_ops.cc, request_path_ops.cc).
+
+    TPU redesign: instead of per-row C++ Update calls into pointer-chasing
+    model state, the device state is a bounded per-group histogram of
+    dictionary codes ([G, CAP] int32 counts) — "add"-mergeable, so partial
+    aggregation and psum merges work by construction — and the model fit
+    runs once at finalize over the observed UNIQUE values (dict values with
+    multiplicities), not over rows.  Codes beyond CAP are dropped: the same
+    bounded-budget approximation as the reference's 64-point coreset
+    (exec/ml/coreset.h).  Distributed plans ship rows for dict-input
+    aggregates (parallel/distributed.py), so cross-agent code spaces never
+    mix.
+    """
+
+    dict_ok = True
+    needs_dict = True  # executor must call finalize_dict, not finalize_host
+    CAP = 256
+
+    def out_type(self, in_type):
+        return DataType.STRING
+
+    def init(self, num_groups, in_dtype=None):
+        return jnp.zeros((num_groups, self.CAP), dtype=jnp.int32)
+
+    def update(self, state, gid, value, mask, num_groups):
+        code = value.astype(jnp.int32)
+        # null codes arrive as a huge sentinel (executor PICKER_NULL_SENTINEL)
+        # and overflow codes are dropped, so `code < CAP` handles both
+        ok = mask & (code >= 0) & (code < self.CAP)
+        c = jnp.clip(code, 0, self.CAP - 1)
+        return state.at[gid, c].add(ok.astype(jnp.int32))
+
+    def reduce_ops(self):
+        return "add"
+
+    def finalize_host(self, state_np):
+        raise NotFound(
+            f"UDA {self.name} needs the input dictionary to finalize "
+            "(needs_dict); the executor must call finalize_dict"
+        )
+
+    def finalize_dict(self, state_np, dictionary) -> np.ndarray:
+        counts = np.asarray(state_np)
+        out = np.empty(counts.shape[0], dtype=object)
+        for g in range(counts.shape[0]):
+            nz = np.nonzero(counts[g] > 0)[0]
+            vals = dictionary.decode(nz.astype(np.int32)) if len(nz) else []
+            out[g] = self.fit_group(list(vals), counts[g][nz])
+        return out
+
+    def fit_group(self, values: list, weights) -> str:
+        """Fit one group's model over unique `values` with multiplicities
+        `weights`; returns the serialized model (a JSON string)."""
+        raise NotImplementedError
 
 
 class QuantileUDA(UDA):

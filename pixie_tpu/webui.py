@@ -319,10 +319,16 @@ class LiveServer:
                 # DNS-rebinding defense: a page at evil.com rebound to
                 # 127.0.0.1 reaches us with Host: evil.com — reject any
                 # Host that is not our own address (localhost variants ok).
+                # Only meaningful for loopback binds: rebinding targets the
+                # attacker-unreachable localhost; an operator who binds a
+                # routable address has exposed the service deliberately and
+                # clients will present that address (or any of the host's
+                # names) as Host.
+                if outer._host not in ("127.0.0.1", "localhost", "::1"):
+                    return True
                 hdr = self.headers.get("Host", "")
                 hostname = hdr.rsplit(":", 1)[0] if ":" in hdr else hdr
-                return hostname in ("127.0.0.1", "localhost", "::1",
-                                    "[::1]", outer._host)
+                return hostname in ("127.0.0.1", "localhost", "::1", "[::1]")
 
             def _send(self, body: str, ctype="text/html", code=200):
                 data = body.encode()
@@ -402,7 +408,13 @@ class LiveServer:
         return _INDEX % links
 
     def _load(self, name: str):
-        d = self.scripts_dir / name
+        # script names are single bundle-dir components; anything with path
+        # separators or leading dots could traverse out of scripts_dir
+        if not name or "/" in name or "\\" in name or name.startswith("."):
+            raise FileNotFoundError(name)
+        d = (self.scripts_dir / name).resolve()
+        if d.parent != self.scripts_dir.resolve():
+            raise FileNotFoundError(name)
         pxls = sorted(d.glob("*.pxl"))
         if not pxls:
             raise FileNotFoundError(name)

@@ -80,6 +80,15 @@ def test_pxtrace_next_probe_predicate_not_scanned_under_prior_body():
         validate_program(bad, "kprobe")
 
 
+def test_pxtrace_same_line_probe_decl_starts_new_scope():
+    """'} kprobe:b {' mid-line is a NEW probe scope — cross-probe $var use
+    must still fail."""
+    bad = ('kprobe:tcp_sendmsg { $sz = arg2; } '
+           'kprobe:tcp_recvmsg { printf("x:%d", $sz); }')
+    with pytest.raises(CompilerError, match=r"\$sz referenced before"):
+        validate_program(bad, "kprobe")
+
+
 def test_vis_func_return_emitted_under_fallback_on_collision():
     """A vis func whose 'output' name is taken by a DIFFERENT frame must
     still emit its returned frame (under output_1), not silently drop it."""

@@ -1,0 +1,183 @@
+"""Model-fitting aggregates: `_build_request_path_clusters` / `_kmeans_fit`.
+
+Reference: src/carnot/funcs/builtins/request_path_ops.cc:40 and
+ml_ops.cc:38 — the last two reference UDF registrations; usage pattern from
+pxbeta/service_endpoints/service_endpoints.pxl:126 (fit → merge-broadcast →
+predict per row).
+"""
+import json
+
+import numpy as np
+import pytest
+
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+
+@pytest.fixture(scope="module")
+def store():
+    rng = np.random.default_rng(11)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS),
+        ("service", DT.STRING),
+        ("req_path", DT.STRING),
+        ("latency", DT.FLOAT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=1024)
+    n = 3000
+    paths = [f"/api/v1/products/sku-{i % 40}" for i in range(n)]
+    for i in range(0, n, 7):
+        paths[i] = "/healthz"
+    t.write({
+        "time_": np.arange(n, dtype=np.int64) * 1000,
+        "service": rng.choice(["cart", "web"], n).tolist(),
+        "req_path": paths,
+        "latency": rng.exponential(10.0, n),
+    })
+    return ts
+
+
+def _run(src, store, **kw):
+    q = compile_pxl(src, store.schemas(), **kw)
+    return execute_plan(q.plan, store)
+
+
+def test_build_request_path_clusters_group_by_none(store):
+    out = _run(
+        "import px\n"
+        "df = px.DataFrame(table='http_events', start_time=0)\n"
+        "df = df.agg(clustering=('req_path', px._build_request_path_clusters))\n"
+        "px.display(df, 'out')\n",
+        store,
+    )["out"]
+    assert out.num_rows == 1
+    code = out.columns["clustering"][0]
+    model = json.loads(out.dictionaries["clustering"].decode([code])[0])
+    templates = {c["template"] for c in model}
+    assert "/api/v1/products/*" in templates
+    assert "/healthz" in templates
+
+
+def test_clustering_feeds_predict_udf_like_service_endpoints(store):
+    """The service_endpoints.pxl pattern: fit a clustering, cross-join it
+    back onto rows, predict the endpoint per row."""
+    out = _run(
+        "import px\n"
+        "df = px.DataFrame(table='http_events', start_time=0)\n"
+        "cl = df.agg(clustering=('req_path', px._build_request_path_clusters))\n"
+        "m = df.merge(cl, how='outer', left_on=[], right_on=[], suffixes=['', ''])\n"
+        "m.endpoint = px._predict_request_path_cluster(m.req_path, m.clustering)\n"
+        "m = m.groupby('endpoint').agg(n=('latency', px.count))\n"
+        "px.display(m, 'out')\n",
+        store,
+    )["out"]
+    eps = set(out.dictionaries["endpoint"].decode(out.columns["endpoint"]))
+    assert eps == {"/api/v1/products/*", "/healthz"}
+    counts = dict(zip(out.dictionaries["endpoint"].decode(
+        out.columns["endpoint"]), out.columns["n"]))
+    assert counts["/healthz"] == len(range(0, 3000, 7))
+    assert sum(counts.values()) == 3000
+
+
+def test_build_request_path_clusters_grouped(store):
+    """Grouped fit: one model per service, each only over its own paths."""
+    out = _run(
+        "import px\n"
+        "df = px.DataFrame(table='http_events', start_time=0)\n"
+        "df = df.groupby('service').agg("
+        "clustering=('req_path', px._build_request_path_clusters))\n"
+        "px.display(df, 'out')\n",
+        store,
+    )["out"]
+    assert out.num_rows == 2
+    for code in out.columns["clustering"]:
+        model = json.loads(out.dictionaries["clustering"].decode([code])[0])
+        assert {"template": "/healthz"} in model
+
+
+def test_kmeans_fit_uda_recovers_blobs():
+    """_kmeans_fit over embedding-JSON strings → centroids JSON usable by
+    _kmeans_inference."""
+    rng = np.random.default_rng(3)
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("embedding", DT.STRING))
+    t = ts.create("embs", rel, batch_rows=512)
+    n = 600
+    centers = np.array([[0.0, 0.0], [30.0, 30.0]])
+    pts = centers[rng.integers(0, 2, n)] + rng.normal(0, 0.3, (n, 2))
+    t.write({
+        "time_": np.arange(n, dtype=np.int64),
+        "embedding": [json.dumps([round(float(a), 3) for a in p])
+                      for p in pts],
+    })
+    import pixie_tpu.flags as flags
+
+    out = _run(
+        "import px\n"
+        "df = px.DataFrame(table='embs', start_time=0)\n"
+        "df = df.agg(model=('embedding', px._kmeans_fit))\n"
+        "px.display(df, 'out')\n",
+        ts,
+    )["out"]
+    model = json.loads(out.dictionaries["model"].decode(
+        out.columns["model"])[0])
+    cents = np.asarray(model["centroids"])
+    assert cents.shape[1] == 2
+    # both true blob centers recovered by SOME centroid
+    for c in centers:
+        assert np.min(np.linalg.norm(cents - c, axis=1)) < 2.0
+    # and the inference scalar consumes the model
+    from pixie_tpu.udf.builtins import _kmeans_inference
+
+    a = _kmeans_inference(json.dumps([0.1, -0.1]), json.dumps(model))
+    b = _kmeans_inference(json.dumps([29.9, 30.2]), json.dumps(model))
+    assert a != b and a >= 0 and b >= 0
+
+
+def test_registry_has_all_reference_ml_uda_names():
+    """The registry diff vs the reference's RegisterOrDie UDA names must be
+    empty (VERDICT r4 item 9)."""
+    from pixie_tpu.udf import registry
+
+    assert registry.has_uda("_kmeans_fit")
+    assert registry.has_uda("_build_request_path_clusters")
+
+
+def test_fit_uda_over_numeric_column_is_clean_error(store):
+    """needs_dict UDA on a numeric column must raise a diagnosable error,
+    not a KeyError at finalize."""
+    from pixie_tpu.status import Unimplemented
+
+    with pytest.raises(Unimplemented, match="dictionary-encoded"):
+        _run(
+            "import px\n"
+            "df = px.DataFrame(table='http_events', start_time=0)\n"
+            "df = df.agg(m=('latency', px._kmeans_fit))\n"
+            "px.display(df, 'out')\n",
+            store,
+        )
+
+
+def test_dict_hist_state_is_mergeable():
+    """DictHistUDA state merges with 'add' (partial-agg capable)."""
+    import jax.numpy as jnp
+
+    from pixie_tpu.ml.fit import RequestPathClusteringFitUDA
+
+    uda = RequestPathClusteringFitUDA()
+    s1 = uda.init(2)
+    s1 = uda.update(s1, jnp.array([0, 1]), jnp.array([3, 5]),
+                    jnp.array([True, True]), 2)
+    s2 = uda.init(2)
+    s2 = uda.update(s2, jnp.array([0]), jnp.array([3]),
+                    jnp.array([True]), 2)
+    m = uda.merge(s1, s2)
+    assert int(m[0, 3]) == 2 and int(m[1, 5]) == 1
+    # null sentinel and overflow codes are dropped
+    s3 = uda.update(uda.init(1), jnp.array([0, 0]),
+                    jnp.array([np.iinfo(np.int32).max, uda.CAP]),
+                    jnp.array([True, True]), 1)
+    assert int(jnp.sum(s3)) == 0

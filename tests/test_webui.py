@@ -220,6 +220,19 @@ def test_rejects_rebound_host_header(server):
     assert ei.value.code == 403
 
 
+def test_script_name_traversal_rejected(server):
+    """'../' in a script name must not escape the bundle directory (404),
+    for both the page and the run API."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/script/%2e%2e%2ftmp")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 404
+    code, out = _post(server, "/api/run", {"script": "../../../tmp"},
+                      token=server.session_token)
+    assert code == 200 and "FileNotFoundError" in out.get("error", "")
+
+
 def test_run_api_rejects_cross_origin(server):
     code, out = _post(server, "/api/run", {"script": "http_data"},
                       token=server.session_token,
