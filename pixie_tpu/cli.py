@@ -262,7 +262,9 @@ def cmd_broker(args) -> int:
 
     broker = Broker(host=args.host, port=args.port,
                     datastore_path=args.datastore,
-                    auth_token=args.auth_token).start()
+                    auth_token=args.auth_token,
+                    healthz_port=args.healthz_port,
+                    election_id=args.election_id).start()
     print(f"broker listening on {args.host}:{broker.port} "
           f"(datastore={args.datastore})", flush=True)
     try:
@@ -337,6 +339,11 @@ def main(argv=None) -> int:
     br.add_argument("--datastore", default=":memory:")
     br.add_argument("--auth-token", default=None,
                     help="require this shared secret from every connection")
+    br.add_argument("--healthz-port", type=int, default=None,
+                    help="serve HTTP /healthz + /metrics on this port")
+    br.add_argument("--election-id", default=None,
+                    help="participate in broker leader election under this "
+                         "instance id (shared --datastore required)")
     br.set_defaults(fn=cmd_broker)
 
     from pixie_tpu.webui import DEFAULT_SCRIPTS

@@ -35,8 +35,19 @@ class Agent:
         heartbeat_s: float = DEFAULT_HEARTBEAT_S,
         n_devices: Optional[int] = None,
         auth_token: Optional[str] = None,
+        healthz_port: Optional[int] = None,
+        healthz_host: str = "127.0.0.1",
     ):
         self.auth_token = auth_token
+        self.healthz = None
+        if healthz_port is not None:
+            from pixie_tpu.services.health import HealthzServer
+
+            self.healthz = HealthzServer(checks={
+                "broker_conn": lambda: (self.conn is not None
+                                        and not self.conn.closed),
+                "registered": lambda: self._registered.is_set(),
+            }, host=healthz_host, port=healthz_port)
         self.name = name
         self.broker = (broker_host, broker_port)
         self.store = store or (collector.store if collector else TableStore())
@@ -70,10 +81,14 @@ class Agent:
             target=self._hb_loop, daemon=True, name=f"pixie-agent-hb-{self.name}"
         )
         self._hb_thread.start()
+        if self.healthz is not None:
+            self.healthz.start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self.healthz is not None:
+            self.healthz.stop()
         if self.collector is not None:
             self.collector.stop()
         if self.conn is not None:
@@ -117,16 +132,22 @@ class Agent:
                 self._register()
                 self.conn.send(wire.encode_json({
                     "msg": "tracepoint_ready", "req_id": payload.get("req_id"),
+                    "qtoken": payload.get("qtoken"),
                     "agent": self.name,
                 }))
             except Exception as e:
                 self.conn.send(wire.encode_json({
                     "msg": "tracepoint_error", "req_id": payload.get("req_id"),
+                    "qtoken": payload.get("qtoken"),
                     "agent": self.name, "error": str(e),
                 }))
 
     def _execute(self, meta: dict):
         req_id = meta.get("req_id", "")
+        # echoed on every result frame; the broker drops frames whose token
+        # doesn't match the live query (per-query result-stream auth,
+        # reference carnotpb/carnot.proto:30-96)
+        qtoken = meta.get("qtoken")
         try:
             plan = Plan.from_dict(meta["plan"])
             ex = PlanExecutor(
@@ -138,7 +159,7 @@ class Agent:
             out = ex.run_agent()
             for channel, payload in out.items():
                 extra = {"msg": "chunk", "req_id": req_id, "channel": channel,
-                         "agent": self.name}
+                         "agent": self.name, "qtoken": qtoken}
                 if isinstance(payload, PartialAggBatch):
                     self.conn.send(wire.encode_partial_agg(payload, extra))
                 elif isinstance(payload, HostBatch):
@@ -151,12 +172,12 @@ class Agent:
 
             self.conn.send(wire.encode_json({
                 "msg": "exec_done", "req_id": req_id, "agent": self.name,
-                "stats": _jsonable(stats),
+                "qtoken": qtoken, "stats": _jsonable(stats),
             }))
         except Exception as e:
             self.conn.send(wire.encode_json({
                 "msg": "exec_error", "req_id": req_id, "agent": self.name,
-                "error": str(e),
+                "qtoken": qtoken, "error": str(e),
             }))
 
 
@@ -174,6 +195,11 @@ def main(argv=None):
     ap.add_argument("--heartbeat-s", type=float, default=DEFAULT_HEARTBEAT_S)
     ap.add_argument("--auth-token", default=None,
                     help="shared secret; required if the broker enables auth")
+    ap.add_argument("--healthz-port", type=int, default=None,
+                    help="serve HTTP /healthz + /metrics on this port")
+    ap.add_argument("--healthz-host", default="127.0.0.1",
+                    help="bind address for the healthz listener (use the "
+                         "pod IP / 0.0.0.0 for remote k8s probes)")
     ap.add_argument("--proc-scan-s", type=float, default=0.0,
                     help="scan /proc every N seconds, binding live PIDs to "
                          "UPIDs (+pods via cgroup) in the metadata state "
@@ -258,7 +284,9 @@ def main(argv=None):
                          daemon=True).start()
 
     agent = Agent(args.name, host, int(port), collector=collector,
-                  heartbeat_s=args.heartbeat_s, auth_token=args.auth_token)
+                  heartbeat_s=args.heartbeat_s, auth_token=args.auth_token,
+                  healthz_port=args.healthz_port,
+                  healthz_host=args.healthz_host)
     agent.start()
     try:
         while True:

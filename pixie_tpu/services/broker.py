@@ -36,11 +36,19 @@ DEFAULT_QUERY_TIMEOUT_S = 60.0
 
 class _QueryCtx:
     def __init__(self, expected_agents: set[str], channels: set[str]):
+        import secrets
+
         self.payloads: dict[str, list] = {c: [] for c in channels}
         self.pending_agents = set(expected_agents)
         self.agent_stats: dict[str, dict] = {}
         self.error: Optional[str] = None
         self.done = threading.Event()
+        #: per-query auth token: agents must echo it on every result chunk
+        #: and completion frame, so a stale/confused/malicious producer
+        #: cannot inject rows into another query's stream (reference: the
+        #: broker injects a per-query auth token into GRPCSinks and the
+        #: result-sink server validates it, carnotpb/carnot.proto:30-96)
+        self.token = secrets.token_urlsafe(12)
 
 
 class Broker:
@@ -53,6 +61,9 @@ class Broker:
         registry=None,
         query_timeout_s: float = DEFAULT_QUERY_TIMEOUT_S,
         auth_token: Optional[str] = None,
+        healthz_port: Optional[int] = None,
+        elector=None,
+        election_id: Optional[str] = None,
     ):
         #: shared-secret auth (reference fronts this port with JWT,
         #: src/shared/services/).  When set, every connection must present the
@@ -79,6 +90,39 @@ class Broker:
             kv=self.kv,
         )
         self._server = Server(host, port, self._on_frame, self._on_close)
+        #: optional LeaderElector (services/election.py): when set, this
+        #: broker only serves queries while holding the lease — a standby
+        #: broker sharing the KV takes over when the leader dies (reference
+        #: src/shared/services/election/).  `election_id` builds one over
+        #: THIS broker's kv (one handle, one close path); election over an
+        #: in-memory datastore is private to the process and therefore
+        #: meaningless across brokers.
+        if election_id is not None and elector is None:
+            from pixie_tpu.services.election import LeaderElector
+            from pixie_tpu.status import InvalidArgument
+
+            if datastore_path == ":memory:":
+                raise InvalidArgument(
+                    "leader election requires a shared --datastore file "
+                    "(an in-memory lease is private to this process)")
+            elector = LeaderElector(self.kv, "broker", election_id)
+        self.elector = elector
+        #: optional HTTP healthz/metrics listener (reference
+        #: src/shared/services/ healthz for k8s probes)
+        self.healthz: Optional[object] = None
+        if healthz_port is not None:
+            from pixie_tpu.services.health import HealthzServer
+
+            def _kv_alive() -> bool:
+                self.kv.get("__healthz")  # raises when the kv is unusable
+                return True
+
+            self.healthz = HealthzServer(checks={
+                "kv": _kv_alive,
+                "server": lambda: not self._stopped.is_set(),
+                "leader": lambda: (self.elector is None
+                                   or self.elector.is_leader()),
+            }, host=host, port=healthz_port)
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
@@ -104,6 +148,10 @@ class Broker:
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
+        if self.elector is not None:
+            self.elector.start()
+        if self.healthz is not None:
+            self.healthz.start()
         return self
 
     def stop(self):
@@ -111,6 +159,10 @@ class Broker:
 
         self._stopped.set()
         self.cron.stop()
+        if self.healthz is not None:
+            self.healthz.stop()
+        if self.elector is not None:
+            self.elector.stop()
         self._server.stop()
         _metrics.unregister_gauge_fn("px_broker_live_agents")
         self.kv.close()
@@ -175,6 +227,7 @@ class Broker:
             elif msg == "tracepoint_ready":
                 self._handle_exec_done({
                     "req_id": payload.get("req_id"),
+                    "qtoken": payload.get("qtoken"),
                     "agent": payload.get("agent"), "stats": {},
                 })
             elif msg == "tracepoint_error":
@@ -277,18 +330,38 @@ class Broker:
         self._agent_conns[name] = conn
         conn.send(wire.encode_json({"msg": "registered", "asid": asid}))
 
-    def _ctx(self, req_id: str) -> Optional[_QueryCtx]:
+    def _ctx(self, meta: dict) -> Optional[_QueryCtx]:
+        """Resolve the query ctx for a producer frame, enforcing the
+        per-query token.  Mismatched/missing tokens are dropped (and
+        counted): a stale producer must not corrupt a newer query that
+        reused context state."""
+        import hmac
+
         with self._qlock:
-            return self._queries.get(req_id)
+            ctx = self._queries.get(meta.get("req_id", ""))
+        if ctx is None:
+            return None
+        # utf-8 bytes operands: compare_digest raises TypeError on non-ASCII
+        # str, which would skip the counted-drop path (same pitfall the auth
+        # handler avoids)
+        if not hmac.compare_digest(
+                str(meta.get("qtoken", "")).encode(), ctx.token.encode()):
+            from pixie_tpu import metrics as _metrics
+
+            _metrics.counter_inc(
+                "px_broker_stale_token_frames_total",
+                help_="producer frames rejected for a bad per-query token")
+            return None
+        return ctx
 
     def _handle_chunk(self, meta: dict, payload):
-        ctx = self._ctx(meta.get("req_id", ""))
+        ctx = self._ctx(meta)
         if ctx is None:
             return
         ctx.payloads.setdefault(meta["channel"], []).append(payload)
 
     def _handle_exec_done(self, meta: dict):
-        ctx = self._ctx(meta.get("req_id", ""))
+        ctx = self._ctx(meta)
         if ctx is None:
             return
         ctx.agent_stats[meta["agent"]] = meta.get("stats", {})
@@ -297,7 +370,7 @@ class Broker:
             ctx.done.set()
 
     def _handle_exec_error(self, meta: dict):
-        ctx = self._ctx(meta.get("req_id", ""))
+        ctx = self._ctx(meta)
         if ctx is None:
             return
         ctx.error = f"agent {meta.get('agent')}: {meta.get('error')}"
@@ -363,6 +436,7 @@ class Broker:
                 for conn in targets.values():
                     conn.send(wire.encode_json({
                         "msg": "deploy_tracepoint", "req_id": rid, "spec": spec,
+                        "qtoken": ctx.token,
                     }))
                 if not ctx.done.wait(timeout=self.query_timeout_s):
                     raise Unavailable(
@@ -406,6 +480,10 @@ class Broker:
         from pixie_tpu.parallel.cluster import _union_host_batches
         from pixie_tpu.status import Internal, Unavailable
 
+        if self.elector is not None and not self.elector.is_leader():
+            leader = self.elector.leader()
+            raise Unavailable(
+                f"this broker is not the leader (current leader: {leader})")
         spec = self.registry.cluster_spec()
         if not any(a.has_data_store for a in spec.agents):
             raise Unavailable("no live data agents registered")
@@ -444,6 +522,7 @@ class Broker:
                     raise Unavailable(f"agent {agent_name} not connected")
                 conn.send(wire.encode_json({
                     "msg": "execute", "req_id": req_id,
+                    "qtoken": ctx.token,
                     "plan": plan.to_dict(), "analyze": analyze,
                     # distributed fan-out: agents route CPU/TPU by the
                     # query's total size, not their local shard's

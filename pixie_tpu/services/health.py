@@ -1,0 +1,78 @@
+"""Healthz endpoint for services (broker/agent).
+
+Reference: src/shared/services/ — every Go service exposes an HTTP
+`/healthz` (and `/metrics`) used by k8s liveness/readiness probes.  The
+framed-TCP data port stays auth-gated; health lives on its own HTTP
+listener so probes need no protocol client or credentials.
+
+GET /healthz  → 200 `{"ok": true, "checks": {...}}` when every registered
+check passes, else 503 with the failing checks' errors.
+GET /metrics  → the Prometheus-style text rendering of pixie_tpu.metrics.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+
+class HealthzServer:
+    """checks: name -> callable returning truthy (healthy) or raising."""
+
+    def __init__(self, checks: Optional[dict[str, Callable]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.checks: dict[str, Callable] = dict(checks or {})
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    ok, results = outer.run_checks()
+                    body = json.dumps({"ok": ok, "checks": results}).encode()
+                    return self._send(200 if ok else 503, body,
+                                      "application/json")
+                if self.path == "/metrics":
+                    from pixie_tpu import metrics as _metrics
+
+                    return self._send(200, _metrics.render().encode(),
+                                      "text/plain; version=0.0.4")
+                return self._send(404, b"not found", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    def run_checks(self) -> tuple[bool, dict]:
+        results = {}
+        ok = True
+        for name, fn in self.checks.items():
+            try:
+                good = bool(fn())
+                results[name] = "ok" if good else "failed"
+                ok = ok and good
+            except Exception as e:
+                results[name] = f"error: {e}"
+                ok = False
+        return ok, results
+
+    def start(self) -> "HealthzServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="pixie-healthz")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

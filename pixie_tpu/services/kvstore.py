@@ -55,6 +55,31 @@ class KVStore:
         for k, v in rows:
             yield k, bytes(v)
 
+    def cas(self, key: str, old: Optional[bytes], new: bytes) -> bool:
+        """Atomic compare-and-set in ONE sqlite transaction (BEGIN IMMEDIATE
+        takes the write lock up front, so a concurrent process cannot
+        interleave between the read and the write — the primitive leader
+        election needs for a race-free lease take-over)."""
+        with self._lock:
+            try:
+                self._conn.execute("BEGIN IMMEDIATE")
+                row = self._conn.execute(
+                    "SELECT v FROM kv WHERE k=?", (key,)).fetchone()
+                cur = None if row is None else bytes(row[0])
+                if cur != old:
+                    self._conn.rollback()
+                    return False
+                self._conn.execute(
+                    "INSERT INTO kv(k, v) VALUES(?, ?) "
+                    "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                    (key, new),
+                )
+                self._conn.commit()
+                return True
+            except sqlite3.OperationalError:
+                self._conn.rollback()
+                return False
+
     # JSON conveniences (control state is JSON-safe by construction)
     def set_json(self, key: str, value) -> None:
         self.set(key, json.dumps(value).encode())
