@@ -65,72 +65,85 @@ class Broker:
         elector=None,
         election_id: Optional[str] = None,
     ):
+        # resource-free validation FIRST: a raise here must not leak a
+        # bound socket or an open KV handle
+        if (election_id is not None and elector is None
+                and datastore_path == ":memory:"):
+            from pixie_tpu.status import InvalidArgument
+
+            raise InvalidArgument(
+                "leader election requires a shared --datastore file "
+                "(an in-memory lease is private to this process)")
         #: shared-secret auth (reference fronts this port with JWT,
         #: src/shared/services/).  When set, every connection must present the
         #: token in an `auth` frame before any other message is honored.  The
         #: port must never be exposed beyond a trusted network regardless.
         self.auth_token = auth_token
-        self.kv = KVStore(datastore_path)
-        self.registry = AgentRegistry(self.kv, expiry_s=hb_expiry_s)
         self.udf_registry = registry
         self.query_timeout_s = query_timeout_s
         self.merger_store = TableStore()
-        from pixie_tpu.services.tracepoints import TracepointManager
-
-        #: cluster-level tracepoint registry (metadata-service analog:
-        #: persisted in the control KV, surfaced by GetTracepointStatus)
-        self.tracepoints = TracepointManager(self.merger_store, kv=self.kv)
-        from pixie_tpu.services.cron import CronScriptRunner
-
-        #: cron scripts (reference script_runner.go:47-54), persisted in kv
-        self.cron = CronScriptRunner(
-            lambda script, func, func_args: self.execute_script(
-                script, func=func, func_args=func_args
-            )[0],
-            kv=self.kv,
-        )
-        self._server = Server(host, port, self._on_frame, self._on_close)
-        #: optional LeaderElector (services/election.py): when set, this
-        #: broker only serves queries while holding the lease — a standby
-        #: broker sharing the KV takes over when the leader dies (reference
-        #: src/shared/services/election/).  `election_id` builds one over
-        #: THIS broker's kv (one handle, one close path); election over an
-        #: in-memory datastore is private to the process and therefore
-        #: meaningless across brokers.
-        if election_id is not None and elector is None:
-            from pixie_tpu.services.election import LeaderElector
-            from pixie_tpu.status import InvalidArgument
-
-            if datastore_path == ":memory:":
-                raise InvalidArgument(
-                    "leader election requires a shared --datastore file "
-                    "(an in-memory lease is private to this process)")
-            elector = LeaderElector(self.kv, "broker", election_id)
-        self.elector = elector
-        #: optional HTTP healthz/metrics listener (reference
-        #: src/shared/services/ healthz for k8s probes)
-        self.healthz: Optional[object] = None
-        if healthz_port is not None:
-            from pixie_tpu.services.health import HealthzServer
-
-            def _kv_alive() -> bool:
-                self.kv.get("__healthz")  # raises when the kv is unusable
-                return True
-
-            self.healthz = HealthzServer(checks={
-                "kv": _kv_alive,
-                "server": lambda: not self._stopped.is_set(),
-                "leader": lambda: (self.elector is None
-                                   or self.elector.is_leader()),
-            }, host=host, port=healthz_port)
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
         self._req_counter = 0
+        self._stopped = threading.Event()
         self._expiry_thread = threading.Thread(
             target=self._expiry_loop, daemon=True, name="pixie-broker-expiry"
         )
-        self._stopped = threading.Event()
+        self.kv = KVStore(datastore_path)
+        self.healthz: Optional[object] = None
+        self._server = None
+        try:
+            self.registry = AgentRegistry(self.kv, expiry_s=hb_expiry_s)
+            from pixie_tpu.services.tracepoints import TracepointManager
+
+            #: cluster-level tracepoint registry (metadata-service analog:
+            #: persisted in the control KV, surfaced by GetTracepointStatus)
+            self.tracepoints = TracepointManager(self.merger_store, kv=self.kv)
+            from pixie_tpu.services.cron import CronScriptRunner
+
+            #: cron scripts (reference script_runner.go:47-54), persisted in kv
+            self.cron = CronScriptRunner(
+                lambda script, func, func_args: self.execute_script(
+                    script, func=func, func_args=func_args
+                )[0],
+                kv=self.kv,
+            )
+            #: optional LeaderElector (services/election.py): when set, this
+            #: broker only serves queries while holding the lease — a standby
+            #: broker sharing the KV takes over when the leader dies
+            #: (reference src/shared/services/election/).  `election_id`
+            #: builds one over THIS broker's kv (one handle, one close path).
+            if election_id is not None and elector is None:
+                from pixie_tpu.services.election import LeaderElector
+
+                elector = LeaderElector(self.kv, "broker", election_id)
+            self.elector = elector
+            #: optional HTTP healthz/metrics listener (reference
+            #: src/shared/services/ healthz for k8s probes).  Leadership is
+            #: a READINESS concern only: a healthy standby must pass
+            #: /healthz (liveness) or a k8s liveness probe would restart it
+            #: in a loop, defeating failover.
+            if healthz_port is not None:
+                from pixie_tpu.services.health import HealthzServer
+
+                def _kv_alive() -> bool:
+                    self.kv.get("__healthz")  # raises when the kv is unusable
+                    return True
+
+                self.healthz = HealthzServer(checks={
+                    "kv": _kv_alive,
+                    "server": lambda: not self._stopped.is_set(),
+                }, ready_checks={
+                    "leader": lambda: (self.elector is None
+                                       or self.elector.is_leader()),
+                }, host=host, port=healthz_port)
+            self._server = Server(host, port, self._on_frame, self._on_close)
+        except Exception:
+            if self.healthz is not None:
+                self.healthz.stop()
+            self.kv.close()
+            raise
 
     # ------------------------------------------------------------------ server
     @property
@@ -351,6 +364,13 @@ class Broker:
             _metrics.counter_inc(
                 "px_broker_stale_token_frames_total",
                 help_="producer frames rejected for a bad per-query token")
+            # surfaced loudly: an agent that never echoes the token (e.g. a
+            # version mismatch) would otherwise present as an opaque query
+            # timeout with only a metric to explain it
+            _metrics.warn(
+                "dropping producer frame with bad per-query token",
+                req_id=meta.get("req_id"), agent=meta.get("agent"),
+                has_token=bool(meta.get("qtoken")))
             return None
         return ctx
 

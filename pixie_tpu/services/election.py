@@ -21,9 +21,18 @@ from typing import Optional
 
 _KEY = "election/%s"
 
-#: serializes the lease read-modify-write across ALL electors in this
-#: process (two in-process brokers sharing one KV must not both win)
-_CAS_LOCK = threading.Lock()
+#: one lock per (kv identity, key): serializes the lease read-modify-write
+#: among in-process electors of the SAME election without coupling
+#: unrelated elections (or blocking is_leader() behind another elector's
+#: sqlite I/O — the kv.cas itself is the cross-process guard)
+_CAS_LOCKS: dict = {}
+_CAS_LOCKS_GUARD = threading.Lock()
+
+
+def _cas_lock(kv, key: str) -> threading.Lock:
+    k = (id(kv), key)
+    with _CAS_LOCKS_GUARD:
+        return _CAS_LOCKS.setdefault(k, threading.Lock())
 
 
 class LeaderElector:
@@ -35,7 +44,7 @@ class LeaderElector:
         self.ttl_s = float(ttl_s)
         self.renew_s = renew_s if renew_s is not None else self.ttl_s / 3
         self._leader = False
-        self._lock = _CAS_LOCK
+        self._lock = _cas_lock(kv, self.key)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -79,8 +88,10 @@ class LeaderElector:
             self._leader = False
 
     def is_leader(self) -> bool:
-        with self._lock:
-            return self._leader
+        # plain bool read (atomic in CPython): must not block behind a
+        # CAS in flight — the health/readiness probes and the per-query
+        # leadership gate call this on hot paths
+        return self._leader
 
     def leader(self) -> Optional[str]:
         """Current holder name (None when the lease is free/expired)."""

@@ -6,7 +6,9 @@ framed-TCP data port stays auth-gated; health lives on its own HTTP
 listener so probes need no protocol client or credentials.
 
 GET /healthz  → 200 `{"ok": true, "checks": {...}}` when every registered
-check passes, else 503 with the failing checks' errors.
+check passes, else 503 with the failing checks' errors (liveness).
+GET /readyz   → same over checks + ready_checks (readiness — e.g. leader
+election: a healthy standby is alive but not ready).
 GET /metrics  → the Prometheus-style text rendering of pixie_tpu.metrics.
 """
 from __future__ import annotations
@@ -21,8 +23,12 @@ class HealthzServer:
     """checks: name -> callable returning truthy (healthy) or raising."""
 
     def __init__(self, checks: Optional[dict[str, Callable]] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ready_checks: Optional[dict[str, Callable]] = None):
         self.checks: dict[str, Callable] = dict(checks or {})
+        #: extra checks for /readyz only (e.g. leadership): failing them
+        #: means "alive but not serving", which must NOT fail liveness
+        self.ready_checks: dict[str, Callable] = dict(ready_checks or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -37,8 +43,9 @@ class HealthzServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/healthz":
-                    ok, results = outer.run_checks()
+                if self.path in ("/healthz", "/readyz"):
+                    ok, results = outer.run_checks(
+                        ready=self.path == "/readyz")
                     body = json.dumps({"ok": ok, "checks": results}).encode()
                     return self._send(200 if ok else 503, body,
                                       "application/json")
@@ -53,10 +60,13 @@ class HealthzServer:
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
 
-    def run_checks(self) -> tuple[bool, dict]:
+    def run_checks(self, ready: bool = False) -> tuple[bool, dict]:
+        checks = dict(self.checks)
+        if ready:
+            checks.update(self.ready_checks)
         results = {}
         ok = True
-        for name, fn in self.checks.items():
+        for name, fn in checks.items():
             try:
                 good = bool(fn())
                 results[name] = "ok" if good else "failed"
@@ -74,5 +84,8 @@ class HealthzServer:
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        # shutdown() blocks forever unless serve_forever() is running —
+        # a stop() after a FAILED service start must not hang cleanup
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()

@@ -1,0 +1,937 @@
+"""Golden-VALUE execution parity, part 2: the remaining bundled scripts.
+
+Same contract as test_script_golden.py (reference CarnotTest golden pattern,
+src/carnot/carnot_test.cc:43): each oracle independently reimplements one of
+the script's vis funcs in pandas/numpy over the same demo store + metadata
+snapshot, and the engine's output must match value-for-value.  With this
+file, all 60 bundled scripts are value-checked (VERDICT r4 item 6).
+
+Shares the part-1 harness: the module fixture here installs the same demo
+cluster into test_script_golden._STATE so its helpers (tdf, run_script,
+assert_frames, metadata maps) work unchanged.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import tests.test_script_golden as g1
+from tests.test_script_golden import (
+    NOW,
+    SEC,
+    SCRIPTS,
+    add_src_dst,
+    assert_frames,
+    ip_pod,
+    nslookup,
+    one_result,
+    q_cmdline,
+    q_ns,
+    q_pod,
+    q_svc,
+    run_default_func,
+    run_script,
+    since,
+    tdf,
+)
+
+ROWS = 800
+WINDOW = 10 * SEC
+
+
+@pytest.fixture(scope="module", autouse=True)
+def demo_cluster():
+    from pixie_tpu.metadata.state import global_manager, set_global_manager
+    from pixie_tpu.testing import build_demo_store, demo_metadata
+
+    old = global_manager()
+    mgr, _upids, _ips = demo_metadata()
+    set_global_manager(mgr)
+    store = build_demo_store(rows=ROWS, now_ns=NOW)
+    g1._STATE["snap"] = mgr.current()
+    g1._STATE["store"] = store
+    yield store
+    set_global_manager(old)
+    g1._STATE.clear()
+
+
+def _snap():
+    return g1._STATE["snap"]
+
+
+def run_func(name: str, func: str, args: dict):
+    """Run one NAMED vis func of a bundled script → results dict."""
+    results, _q = run_script(name, func=func, args=args)
+    return results
+
+
+APPROX_Q = ("latency_p50", "latency_p90", "latency_p99")
+#: float-divided metrics: equal up to one ulp of the division order
+APPROX_RATES = ("request_throughput", "error_rate")
+
+
+def _q(series_or_groupby, q: float):
+    """Rank-based quantile matching the engine's log-histogram semantics
+    (ops/sketch.py: first bin whose cumulative count reaches q*total ==
+    numpy's inverted_cdf).  The sketch then has only the ~1% bucket-width
+    (gamma=1.02) representative error, so comparisons stay tight even for
+    tiny groups where interpolating definitions diverge wildly."""
+    return series_or_groupby.apply(
+        lambda s: np.quantile(np.asarray(s, dtype=np.float64), q,
+                              method="inverted_cdf"))
+
+
+# ------------------------------------------------- *_stats LET family (4)
+
+
+def _let_oracle(table: str, groups: list[str], failure=None,
+                pre_filter=None) -> pd.DataFrame:
+    """The shared <proto>_let_per_pod shape (e.g. mysql_stats.pxl
+    mysql_let_per_pod): add source/dest, bin to 10s windows, drop rows with
+    no pod, group + quantiles/count (+ error rate when `failure` given)."""
+    df = add_src_dst(since(tdf(table), 300))
+    df = df[df["pod"] != ""].copy()
+    if pre_filter is not None:
+        df = pre_filter(df)
+    df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+    agg = {"throughput_total": ("latency", "count")}
+    if failure is not None:
+        df["failure"] = failure(df)
+        agg["error_rate_per_window"] = ("failure", "mean")
+    q = df.groupby(groups, as_index=False).agg(**agg)
+    lat = df.groupby(groups)["latency"]
+    q["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+    q["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+    q["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+    q["time_"] = q["timestamp"]
+    q["request_throughput"] = q["throughput_total"] / WINDOW
+    if failure is not None:
+        q["error_rate"] = q["error_rate_per_window"] * q["request_throughput"]
+    return q
+
+
+class TestProtoStats:
+    def test_mysql_stats_pod_let(self):
+        res = one_result(run_func(
+            "mysql_stats", "pod_mysql_let",
+            {"start_time": "-5m", "pod": ""}))
+        exp = _let_oracle(
+            "mysql_events", ["timestamp", "destination"],
+            failure=lambda d: d["resp_status"] == 3,
+            pre_filter=lambda d: d[d["resp_status"] != 1])
+        exp = exp[["time_", "destination", "latency_p50", "latency_p90",
+                   "latency_p99", "error_rate", "request_throughput"]]
+        assert_frames(res, exp, approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+    def test_pgsql_stats_pod_let(self):
+        res = one_result(run_func(
+            "pgsql_stats", "pod_pgsql_let",
+            {"start_time": "-5m", "pod": ""}))
+        exp = _let_oracle("pgsql_events", ["timestamp", "destination"])
+        exp = exp[["time_", "destination", "latency_p50", "latency_p90",
+                   "latency_p99", "request_throughput"]]
+        assert_frames(res, exp, approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+    def test_redis_stats_pod_let(self):
+        res = one_result(run_func(
+            "redis_stats", "pod_redis_let",
+            {"start_time": "-5m", "pod": ""}))
+        exp = _let_oracle("redis_events", ["timestamp", "destination"])
+        exp = exp[["time_", "destination", "latency_p50", "latency_p90",
+                   "latency_p99", "request_throughput"]]
+        assert_frames(res, exp, approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+    def test_cql_stats_pod_let(self):
+        # cql groups by the POD (ctx) + remote_addr, not source/destination
+        res = one_result(run_func(
+            "cql_stats", "pod_cql_let", {"start_time": "-5m", "pod": ""}))
+        df = since(tdf("cql_events"), 300).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[df["pod"] != ""]
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        df["failure"] = df["resp_op"] == 0
+        groups = ["pod", "timestamp", "remote_addr"]
+        q = df.groupby(groups, as_index=False).agg(
+            throughput_total=("latency", "count"),
+            error_rate_per_window=("failure", "mean"))
+        lat = df.groupby(groups)["latency"]
+        q["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+        q["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+        q["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+        q["time_"] = q["timestamp"]
+        q["request_throughput"] = q["throughput_total"] / WINDOW
+        q["error_rate"] = (q["error_rate_per_window"]
+                           * q["request_throughput"])
+        q["k8s"] = q["pod"]
+        q["CQL IP"] = q["remote_addr"]
+        exp = q[["time_", "k8s", "CQL IP", "latency_p50", "latency_p90",
+                 "latency_p99", "error_rate", "request_throughput"]]
+        assert_frames(res, exp, approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+
+# ---------------------------------------------- *_flow_graph family (3)
+
+
+def _flow_graph_oracle(table: str) -> pd.DataFrame:
+    """mysql_flow_graph.pxl mysql_flow_graph(ns='default') shape (pgsql and
+    redis differ only in the source table): source/dest columns, filter to
+    the namespace, 10s windows with quantiles+count, then a second aggregate
+    averaging the per-window metrics per edge."""
+    df = since(tdf(table), 300).copy()
+    df["pod"] = df["upid"].map(q_pod)
+    df["namespace"] = df["upid"].map(q_ns)
+    ra_pod = df["remote_addr"].map(ip_pod)
+    is_ra_pod = ra_pod != ""
+    ra_name = np.where(is_ra_pod, ra_pod, df["remote_addr"])
+    server = df["trace_role"] == 2
+    df["is_source_pod_type"] = np.where(server, is_ra_pod, True)
+    df["is_dest_pod_type"] = np.where(server, True, is_ra_pod)
+    df["source"] = np.where(server, ra_name, df["pod"])
+    df["destination"] = np.where(server, df["pod"], ra_name)
+    df = df[(df["source"] != "") & (df["destination"] != "")]
+    df = df[df["namespace"] == "default"]
+    df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+    g1cols = ["timestamp", "source", "destination", "is_source_pod_type",
+              "is_dest_pod_type", "namespace"]
+    w = df.groupby(g1cols, as_index=False).agg(
+        throughput_total=("latency", "count"))
+    lat = df.groupby(g1cols)["latency"]
+    w["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+    w["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+    w["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+    w["request_throughput"] = w["throughput_total"] / WINDOW
+    g2cols = ["source", "destination", "is_source_pod_type",
+              "is_dest_pod_type", "namespace"]
+    out = w.groupby(g2cols, as_index=False).agg(
+        latency_p50=("latency_p50", "mean"),
+        latency_p90=("latency_p90", "mean"),
+        latency_p99=("latency_p99", "mean"),
+        request_throughput=("request_throughput", "mean"),
+        throughput_total=("throughput_total", "sum"))
+    return out
+
+
+class TestFlowGraphs:
+    ARGS = {"start_time": "-5m", "ns": "default", "source_filter": "",
+            "destination_filter": ""}
+
+    def _check(self, script, func, table):
+        res = one_result(run_func(script, func, self.ARGS))
+        assert_frames(res, _flow_graph_oracle(table),
+                      approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+    def test_mysql_flow_graph(self):
+        self._check("mysql_flow_graph", "mysql_flow_graph", "mysql_events")
+
+    def test_pgsql_flow_graph(self):
+        self._check("pgsql_flow_graph", "pgsql_flow_graph", "pgsql_events")
+
+    def test_redis_flow_graph(self):
+        self._check("redis_flow_graph", "redis_flow_graph", "redis_events")
+
+
+# ------------------------------------------------------------- conns + dns
+
+
+class TestConnAndDns:
+    def test_outbound_conns(self):
+        res = one_result(run_func(
+            "outbound_conns", "outbound_conns",
+            {"start_time": "-24h", "ip_filter": ""}))
+        df = tdf("conn_stats").copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[df["trace_role"] == 1]
+        snap = _snap()
+        rp = df["remote_addr"].map(lambda ip: snap.ip_to_pod_uid.get(ip, ""))
+        rs = df["remote_addr"].map(
+            lambda ip: snap.ip_to_service_uid.get(ip, ""))
+        df = df[(rp == "") & (rs == "")]
+        df = df[~df["remote_addr"].isin(["127.0.0.1", "0.0.0.0"])]
+        g = (df.groupby(["pod", "upid", "remote_addr", "remote_port"],
+                        as_index=False)
+             .agg(co_min=("conn_open", "min"), co_max=("conn_open", "max"),
+                  bs_min=("bytes_sent", "min"), bs_max=("bytes_sent", "max"),
+                  br_min=("bytes_recv", "min"), br_max=("bytes_recv", "max"),
+                  last_activity_time=("time_", "max")))
+        g["conn_open"] = g["co_max"] - g["co_min"]
+        g["bytes_sent"] = g["bs_max"] - g["bs_min"]
+        g["bytes_recv"] = g["br_max"] - g["br_min"]
+        out = (g.groupby(["pod", "remote_addr", "remote_port"],
+                         as_index=False)
+               .agg(conn_open=("conn_open", "sum"),
+                    bytes_sent=("bytes_sent", "sum"),
+                    bytes_recv=("bytes_recv", "sum"),
+                    last_activity_time=("last_activity_time", "max")))
+        exp = out[["pod", "remote_addr", "remote_port", "conn_open",
+                   "bytes_sent", "bytes_recv", "last_activity_time"]]
+        assert_frames(res, exp)
+
+    def test_dns_query_summary(self):
+        results = run_func("dns_query_summary", "dns_queries", {
+            "start_time": "-5m", "namespace": "", "pod_filter": "",
+            "query_filter": "", "dns_server_filter": ""})
+        res = results["output"]  # px.debug adds a second "_events" sink
+        df = since(tdf("dns_events"), 300).copy()
+        df = df[df["trace_role"] == 1]
+        df["pod"] = df["upid"].map(q_pod)
+        # demo req/resp bodies carry no DNS JSON: pluck("queries") == "",
+        # find on "" == -1, substring("", 7, -8) == "" — qname is "" and
+        # resolved/nxdomain are False for every row (the engine must agree)
+        df["dns_server"] = df["remote_addr"].map(nslookup)
+        g = (df.groupby(["pod", "dns_server"], as_index=False)
+             .agg(num_requests=("time_", "count")))
+        g["qname"] = ""
+        g["num_resolved"] = 0
+        g["num_nxdomain"] = 0
+        g["unresolved_rate"] = 1.0
+        g["nxdomain_rate"] = 0.0
+        g["qgroup"] = " @" + g["dns_server"]
+        exp = g[["pod", "dns_server", "qname", "num_requests",
+                 "num_resolved", "num_nxdomain", "unresolved_rate",
+                 "nxdomain_rate", "qgroup"]]
+        assert_frames(res, exp)
+
+    def test_slow_http_requests_empty_at_100ms_threshold(self):
+        # demo latencies are ~2ms exponential: the script's >100ms filter
+        # must yield EXACTLY zero rows (a wrong filter direction or unit
+        # would not)
+        res = one_result(run_func(
+            "slow_http_requests", "namespace_slow_requests",
+            {"start_time": "-5m", "namespace": "default"}))
+        assert res.num_rows == 0
+        assert set(res.relation.names()) == {
+            "time_", "source", "destination", "remote_port", "latency",
+            "req_method", "req_path", "resp_status", "resp_body"}
+
+
+# ---------------------------------------------------- sql + jvm scripts
+
+
+class TestSqlAndJvm:
+    @staticmethod
+    def _norm(q: str) -> str:
+        # independent literal-normalization: quoted strings then bare
+        # numbers become '?' (reference sql_ops.cc placeholder rewriting)
+        import re
+
+        q = re.sub(r"'(?:[^'\\]|\\.)*'", "?", q)
+        q = re.sub(r"\b\d+(?:\.\d+)?\b", "?", q)
+        return re.sub(r"\s+", " ", q).strip()
+
+    def _sql_events(self) -> pd.DataFrame:
+        """merged_let_per_pod input rows: pgsql Query/Execute + mysql
+        COM_QUERY(3)/COM_STMT_EXECUTE(23), each source/dest formatted and
+        normalized."""
+        pg = add_src_dst(since(tdf("pgsql_events"), 300))
+        pg = pg[pg["pod"] != ""]
+        pg = pg[pg["req_cmd"].isin(["Execute", "Query"])].copy()
+        pg["normed_query"] = pg["req"].map(self._norm)
+        my = add_src_dst(since(tdf("mysql_events"), 300))
+        my = my[my["pod"] != ""]
+        my = my[my["req_cmd"].isin([3, 23])].copy()
+        my["normed_query"] = my["req_body"].map(self._norm)
+        df = pd.concat([pg, my], ignore_index=True)
+        df = df[df["normed_query"] != ""]
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        return df
+
+    def test_sql_queries_pod_let(self):
+        res = one_result(run_func(
+            "sql_queries", "pod_sql_let", {"start_time": "-5m", "pod": ""}))
+        df = self._sql_events()
+        groups = ["timestamp", "normed_query"]
+        q = df.groupby(groups, as_index=False).agg(
+            throughput_total=("latency", "count"))
+        lat = df.groupby(groups)["latency"]
+        q["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+        q["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+        q["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+        q["time_"] = q["timestamp"]
+        q["request_throughput"] = q["throughput_total"] / WINDOW
+        exp = q[["time_", "normed_query", "latency_p50", "latency_p90",
+                 "latency_p99", "request_throughput"]]
+        assert_frames(res, exp, approx=APPROX_Q + APPROX_RATES, rtol=0.05)
+
+    def test_sql_query_default_filter_is_empty(self):
+        # the default normed_query arg ('-5m', the vis variable default)
+        # matches no normalized query: exactly 0 rows, schema intact
+        res = one_result(run_func(
+            "sql_query", "pod_sql_let",
+            {"start_time": "-5m", "pod": "", "normed_query": "-5m"}))
+        assert res.num_rows == 0
+        assert set(res.relation.names()) == {
+            "time_", "normed_query", "params", "latency_p50", "latency_p90",
+            "latency_p99", "request_throughput"}
+
+    def test_jvm_stats(self):
+        res = one_result(run_func(
+            "jvm_stats", "jvm_stats",
+            {"start_time": "-5m", "node_name": "", "pod": ""}))
+        df = since(tdf("jvm_stats"), 300).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        by_upid = (df.groupby(["upid", "pod", "timestamp"], as_index=False)
+                   .agg(ygc_max=("young_gc_time", "max"),
+                        ygc_min=("young_gc_time", "min"),
+                        fgc_max=("full_gc_time", "max"),
+                        fgc_min=("full_gc_time", "min"),
+                        used_heap_size=("used_heap_size", "mean"),
+                        total_heap_size=("total_heap_size", "mean"),
+                        max_heap_size=("max_heap_size", "mean")))
+        by_upid["young_gc_time"] = by_upid["ygc_max"] - by_upid["ygc_min"]
+        by_upid["full_gc_time"] = by_upid["fgc_max"] - by_upid["fgc_min"]
+        per = (by_upid.groupby(["pod", "timestamp"], as_index=False)
+               .agg(young_gc_time=("young_gc_time", "sum"),
+                    full_gc_time=("full_gc_time", "sum"),
+                    used_heap_size=("used_heap_size", "sum"),
+                    max_heap_size=("max_heap_size", "sum"),
+                    total_heap_size=("total_heap_size", "sum")))
+        per["time_"] = per["timestamp"]
+        per["k8s"] = per["pod"]
+        exp = per[["pod", "timestamp", "young_gc_time", "full_gc_time",
+                   "used_heap_size", "max_heap_size", "total_heap_size",
+                   "time_", "k8s"]]
+        assert_frames(res, exp, approx=(
+            "used_heap_size", "max_heap_size", "total_heap_size"), rtol=1e-9)
+
+
+# ------------------------------------------------ introspection scripts
+
+
+class TestIntrospectionScripts:
+    def test_upids_for_namespace(self):
+        res = one_result(run_func(
+            "upids", "upids_for_namespace",
+            {"start_time": "-5m", "namespace": "default"}))
+        snap = _snap()
+        df = since(tdf("process_stats"), 300).copy()
+        df["ns"] = df["upid"].map(q_ns)
+        df = df[df["ns"] == "default"]
+        df["pod"] = df["upid"].map(q_pod)
+        df["container"] = df["upid"].map(
+            lambda u: snap.containers_by_id[
+                snap.upid_to_container_id[u]].name
+            if u in snap.upid_to_container_id else "")
+        df["cmdline"] = df["upid"].map(q_cmdline)
+        g = (df.groupby(["pod", "container", "upid", "cmdline"],
+                        as_index=False).size().drop(columns="size"))
+        g["pod_create_time"] = 1 * SEC  # all demo pods start at t=1s
+        assert_frames(res, g)
+
+    def test_schemas_table_desc(self):
+        res = one_result(run_func("schemas", "table_desc", {}))
+        got = sorted(res.dictionaries["table_name"].decode(
+            res.columns["table_name"]))
+        from pixie_tpu.collect.schemas import all_schemas
+
+        want = sorted(set(all_schemas()) | set(g1._STATE["store"].schemas()))
+        assert got == want
+
+    def test_funcs_agg_funcs_lists_every_uda(self):
+        res = one_result(run_func("funcs", "agg_funcs", {}))
+        from pixie_tpu.udf import registry
+
+        got = sorted(res.dictionaries["name"].decode(res.columns["name"]))
+        assert got == sorted(registry.uda_names())
+        assert "_kmeans_fit" in got and "quantiles" in got
+
+    def test_tracepoint_status_empty_without_deployments(self):
+        res = one_result(run_func("tracepoint_status", "tracepoint_info", {}))
+        assert res.num_rows == 0
+        assert "state" in res.relation.names()
+
+    def test_agent_status_local(self):
+        results, _q2 = run_script("agent_status")
+        res = one_result(results)
+        # local (agent-less) execution: one row per... no registry → empty;
+        # the relation must still be the reference's GetAgentStatus shape
+        assert set(res.relation.names()) == {
+            "agent_id", "asid", "hostname", "ip_address", "agent_state",
+            "create_time", "last_heartbeat_ns"}
+
+
+# --------------------------------------------- entity overview scripts
+
+
+def _pstats(win_s: int = 300) -> pd.DataFrame:
+    df = since(tdf("process_stats"), win_s).copy()
+    df["pod"] = df["upid"].map(q_pod)
+    df["ns"] = df["upid"].map(q_ns)
+    df["service"] = df["upid"].map(q_svc)
+    return df
+
+
+class TestEntityOverviews:
+    def test_namespaces_for_cluster(self):
+        res = one_result(run_func(
+            "namespaces", "namespaces_for_cluster", {"start_time": "-5m"}))
+        df = _pstats()
+        d = df.drop_duplicates(["service", "pod", "ns"])
+        exp = (d.groupby("ns", as_index=False)
+               .agg(pod_count=("pod", "count"),
+                    service_count=("service", "count")))
+        exp = exp.rename(columns={"ns": "namespace"})
+        assert_frames(res, exp)
+
+    def test_pods_list(self):
+        res = one_result(run_func(
+            "pods", "pods", {"start_time": "-5m", "namespace": "default"}))
+        df = _pstats()
+        snap = _snap()
+        df = df[df["ns"] == "default"].copy()
+        df["container"] = df["upid"].map(
+            lambda u: snap.containers_by_id[
+                snap.upid_to_container_id[u]].name)
+        d = df.drop_duplicates(["service", "pod", "container"])
+        exp = (d.groupby(["service", "pod"], as_index=False)
+               .agg(containers=("container", "count")))
+        exp["start_time"] = 1 * SEC
+        exp["status"] = "Running"
+        exp = exp[["pod", "service", "start_time", "containers", "status"]]
+        assert_frames(res, exp)
+
+    def test_services_list(self):
+        res = one_result(run_func(
+            "services", "services",
+            {"start_time": "-5m", "namespace": "default"}))
+        df = _pstats()
+        df = df[(df["ns"] == "default") & (df["service"] != "")]
+        d = df.drop_duplicates(["service", "pod"])
+        exp = (d.groupby("service", as_index=False)
+               .agg(pod_count=("pod", "count")))
+        assert_frames(res, exp)
+
+    def test_namespace_pods(self):
+        res = one_result(run_func(
+            "namespace", "pods_for_namespace",
+            {"start_time": "-5m", "namespace": "default"}))
+        df = _pstats()
+        df = df[df["ns"] == "default"]
+        exp = (df.groupby("pod", as_index=False)
+               .agg(rss=("rss_bytes", "mean"), vsize=("vsize_bytes", "mean")))
+        exp["create_time"] = 1 * SEC
+        exp["status"] = "Running"
+        assert_frames(res, exp, approx=("rss", "vsize"), rtol=1e-9)
+
+    def test_node_pods(self):
+        res = one_result(run_func(
+            "node", "pods_for_node",
+            {"start_time": "-5m", "node": "node-1"}))
+        df = _pstats()
+        snap = _snap()
+        df = df.copy()
+        df["container"] = df["upid"].map(
+            lambda u: snap.containers_by_id[
+                snap.upid_to_container_id[u]].name)
+        d = df.drop_duplicates(["pod", "container"])
+        exp = (d.groupby("pod", as_index=False)
+               .agg(containers=("container", "count")))
+        exp["start_time"] = 1 * SEC
+        exp["status"] = "Running"
+        exp = exp[["pod", "start_time", "containers", "status"]]
+        assert_frames(res, exp)
+
+    def test_nodes_process_stats(self):
+        res = one_result(run_func(
+            "nodes", "process_stats", {"start_time": "-5m"}))
+        df = since(tdf("process_stats"), 300).copy()
+        df["node"] = "node-1"  # demo cluster is single-node
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        per = (df.groupby(["node", "upid", "timestamp"], as_index=False)
+               .agg(rss=("rss_bytes", "mean"), vsize=("vsize_bytes", "mean"),
+                    cu_max=("cpu_utime_ns", "max"),
+                    cu_min=("cpu_utime_ns", "min"),
+                    ck_max=("cpu_ktime_ns", "max"),
+                    ck_min=("cpu_ktime_ns", "min"),
+                    rb_max=("read_bytes", "max"), rb_min=("read_bytes", "min"),
+                    wb_max=("write_bytes", "max"),
+                    wb_min=("write_bytes", "min"),
+                    rc_max=("rchar_bytes", "max"),
+                    rc_min=("rchar_bytes", "min"),
+                    wc_max=("wchar_bytes", "max"),
+                    wc_min=("wchar_bytes", "min")))
+        per["cpu_utime_ns"] = per["cu_max"] - per["cu_min"]
+        per["cpu_ktime_ns"] = per["ck_max"] - per["ck_min"]
+        per["adrt"] = (per["rb_max"] - per["rb_min"]) / WINDOW
+        per["adwt"] = (per["wb_max"] - per["wb_min"]) / WINDOW
+        per["tdrt"] = (per["rc_max"] - per["rc_min"]) / WINDOW
+        per["tdwt"] = (per["wc_max"] - per["wc_min"]) / WINDOW
+        out = (per.groupby(["node", "timestamp"], as_index=False)
+               .agg(cpu_ktime_ns=("cpu_ktime_ns", "sum"),
+                    cpu_utime_ns=("cpu_utime_ns", "sum"),
+                    actual_disk_read_throughput=("adrt", "sum"),
+                    actual_disk_write_throughput=("adwt", "sum"),
+                    total_disk_read_throughput=("tdrt", "sum"),
+                    total_disk_write_throughput=("tdwt", "sum"),
+                    rss=("rss", "sum"), vsize=("vsize", "sum")))
+        out["cpu_usage"] = (out["cpu_ktime_ns"] + out["cpu_utime_ns"]) / WINDOW
+        out["time_"] = out["timestamp"]
+        exp = out.drop(columns=["cpu_ktime_ns", "cpu_utime_ns", "timestamp"])
+        assert_frames(
+            res, exp,
+            approx=("actual_disk_read_throughput",
+                    "actual_disk_write_throughput",
+                    "total_disk_read_throughput",
+                    "total_disk_write_throughput", "rss", "vsize",
+                    "cpu_usage"),
+            rtol=1e-9)
+
+    def test_cluster_nodes(self):
+        res = one_result(run_func(
+            "cluster", "nodes_for_cluster", {"start_time": "-5m"}))
+        df = _pstats()
+        pod_count = df.drop_duplicates(["pod"]).shape[0]
+        # cpu_usage: per (node, upid, window) counter deltas, summed per
+        # window, averaged over windows (process_stats_by_entity)
+        d = since(tdf("process_stats"), 300).copy()
+        d["node"] = "node-1"
+        d["timestamp"] = (d["time_"] // WINDOW) * WINDOW
+        per = (d.groupby(["node", "upid", "timestamp"], as_index=False)
+               .agg(cu_max=("cpu_utime_ns", "max"),
+                    cu_min=("cpu_utime_ns", "min"),
+                    ck_max=("cpu_ktime_ns", "max"),
+                    ck_min=("cpu_ktime_ns", "min")))
+        per["cu"] = per["cu_max"] - per["cu_min"]
+        per["ck"] = per["ck_max"] - per["ck_min"]
+        w = (per.groupby(["node", "timestamp"], as_index=False)
+             .agg(cu=("cu", "sum"), ck=("ck", "sum")))
+        byn = w.groupby("node", as_index=False).agg(
+            cu=("cu", "mean"), ck=("ck", "mean"))
+        exp = pd.DataFrame({
+            "node": byn["node"],
+            "cpu_usage": (byn["ck"] + byn["cu"]) / WINDOW,
+            "pod_count": pod_count,
+        })
+        assert_frames(res, exp, approx=("cpu_usage",), rtol=1e-9)
+
+    def test_pod_containers(self):
+        res = one_result(run_func(
+            "pod", "containers",
+            {"start_time": "-5m", "pod": "default/frontend-0"}))
+        exp = pd.DataFrame({
+            "name": ["frontend-ctr"], "id": ["ctr-0-0"],
+            "status": ["Running"]})
+        assert_frames(res, exp)
+
+    def test_service_pods(self):
+        res = one_result(run_func(
+            "service", "pods_for_service",
+            {"start_time": "-5m", "service": "default/frontend"}))
+        exp = pd.DataFrame({
+            "pod": ["default/frontend-0", "default/frontend-1"],
+            "pod_create_time": [1 * SEC, 1 * SEC],
+            "pod_status": ["Running", "Running"]})
+        assert_frames(res, exp)
+
+
+# --------------------------------------------------- http LET families
+
+
+class TestHttpLetScripts:
+    def _http_table(self) -> pd.DataFrame:
+        """service_stats.pxl make_http_table: service ctx, 10s windows,
+        failure flag, health/ready/unresolved filters."""
+        df = since(tdf("http_events"), 300).copy()
+        df["service"] = df["upid"].map(q_svc)
+        df = df[df["service"] != ""]
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        df["failure"] = df["resp_status"] >= 400
+        df = df[(df["req_path"] != "/healthz") & (df["req_path"] != "/readyz")
+                & (df["remote_addr"] != "-")]
+        return df
+
+    def test_service_stats_http_code_histogram(self):
+        res = one_result(run_func(
+            "service_stats", "http_code_histogram",
+            {"start_time": "-5m", "svc": ""}))
+        exp = (self._http_table().groupby("resp_status", as_index=False)
+               .agg(count=("latency", "count")))
+        assert_frames(res, exp)
+
+    def test_service_edge_stats_svc_edge_let(self):
+        res = one_result(run_func(
+            "service_edge_stats", "svc_edge_let",
+            {"start_time": "-5m", "requesting_svc": "",
+             "responding_svc": ""}))
+        df = self._http_table()
+        groups = ["remote_addr", "service", "timestamp"]
+        q = df.groupby(groups, as_index=False).agg(
+            throughput_total=("latency", "count"),
+            error_rate_per_window=("failure", "mean"),
+            bytes_total=("resp_body_size", "sum"))
+        lat = df.groupby(groups)["latency"]
+        q["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+        q["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+        q["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+        q["time_"] = q["timestamp"]
+        q["request_throughput"] = q["throughput_total"] / WINDOW
+        q["bytes_throughput"] = q["bytes_total"] / WINDOW
+        q["error_rate"] = q["error_rate_per_window"] * q["request_throughput"]
+        snap = _snap()
+
+        def svc_of_ip(ip):
+            # script: ip -> pod_id -> pod's service (ip_to_svc_name)
+            p = snap.pod_of_ip(ip)
+            if p is None:
+                return ""
+            suids = snap.pod_uid_to_service_uids.get(p.uid, ())
+            svcs = [snap.services_by_uid[s] for s in suids
+                    if s in snap.services_by_uid]
+            return svcs[0].qualified_name if svcs else ""
+
+        q["requestor"] = q["remote_addr"].map(svc_of_ip)
+        q["k8s"] = q["service"]
+        q["responder"] = q["service"]
+        cols = ["time_", "requestor", "k8s", "responder", "latency_p50",
+                "latency_p90", "latency_p99", "error_rate",
+                "request_throughput", "bytes_throughput"]
+        exp = q[cols]
+        got = res.to_pandas()[cols]
+        assert len(got) == len(exp)
+        # the output drops remote_addr, so two edges can share every exact
+        # key (different unresolved IPs → requestor ''): align by keys +
+        # count + the p50 value itself (order-stable under ~1% sketch error)
+        def order(d):
+            d = d.copy()
+            d["_n"] = np.round(d["request_throughput"] * WINDOW)
+            return d.sort_values(
+                ["time_", "requestor", "responder", "_n", "latency_p50"]
+            ).reset_index(drop=True).drop(columns="_n")
+
+        gs, es = order(got), order(exp)
+        for c in ("time_", "requestor", "k8s", "responder"):
+            assert gs[c].tolist() == es[c].tolist(), c
+        for c in APPROX_Q + APPROX_RATES + ("bytes_throughput",):
+            np.testing.assert_allclose(
+                gs[c].to_numpy(float), es[c].to_numpy(float), rtol=0.05,
+                err_msg=c)
+
+    def test_pod_edge_stats_empty_for_default_pods(self):
+        # the vis defaults name a nonexistent pod — exactly 0 rows
+        res = one_result(run_func(
+            "pod_edge_stats", "http_code_agg",
+            {"start_time": "-5m", "requesting_pod": "default/pod",
+             "responding_pod": "default/pod"}))
+        assert res.num_rows == 0
+        assert set(res.relation.names()) == {"resp_status", "count"}
+
+
+# ------------------------------------------- module-level + remaining
+
+
+class TestModuleScripts:
+    def test_pod_lifetime_resource(self):
+        results, _q2 = run_script("pod_lifetime_resource")
+        res = one_result(results)
+        df = since(tdf("process_stats"), 60).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        per = (df.groupby(["upid", "pod"], as_index=False)
+               .agg(vsize=("vsize_bytes", "mean"), rss=("rss_bytes", "mean"),
+                    cpu_utime_ns=("cpu_utime_ns", "max"),
+                    cpu_ktime_ns=("cpu_ktime_ns", "max"),
+                    read_bytes=("read_bytes", "max"),
+                    write_bytes=("write_bytes", "max"),
+                    rchar_bytes=("rchar_bytes", "max"),
+                    wchar_bytes=("wchar_bytes", "max")))
+        out = (per.groupby("pod", as_index=False)
+               .agg(cpu_utime_ns=("cpu_utime_ns", "sum"),
+                    cpu_ktime_ns=("cpu_ktime_ns", "sum"),
+                    vsize=("vsize", "sum"), rss=("rss", "sum"),
+                    read_bytes=("read_bytes", "sum"),
+                    write_bytes=("write_bytes", "sum"),
+                    rchar_bytes=("rchar_bytes", "sum"),
+                    wchar_bytes=("wchar_bytes", "sum")))
+        exp = pd.DataFrame({
+            "pod_name": out["pod"], "status": "Running",
+            "Created on": 1 * SEC,
+            "CPU User time": out["cpu_utime_ns"],
+            "CPU System time": out["cpu_ktime_ns"],
+            "Virtual Memory": out["vsize"], "Average Memory": out["rss"],
+            "Read to IO": out["read_bytes"],
+            "Write to IO": out["write_bytes"],
+            "Characters Read": out["rchar_bytes"],
+            "Characters written": out["wchar_bytes"]})
+        assert_frames(res, exp,
+                      approx=("Virtual Memory", "Average Memory"), rtol=1e-9)
+
+    def test_pixie_quality_metrics_http_latencies(self):
+        results, _q2 = run_script("pixie_quality_metrics")
+        res = results["http_latencies"]
+        df = since(tdf("http_events"), 300).copy()
+        df["latency_huge"] = df["latency"] > 10 * 1000 * 1000
+        df["negative_latencies"] = df["latency"] < 0
+        exp = (df.groupby(["latency_huge", "negative_latencies"],
+                          as_index=False).agg(count=("latency", "count")))
+        assert_frames(res, exp)
+        assert set(results) >= {"http_latencies", "mysql_latencies",
+                                "java_processes", "jvm_stats"}
+
+    def test_service_resource_usage(self):
+        results, _q2 = run_script("service_resource_usage")
+        res = one_result(results)
+        # process side
+        df = since(tdf("process_stats"), 600).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df["service"] = df["upid"].map(q_svc)
+        df = df[df["service"] != ""]
+        per = (df.groupby(["service", "pod", "upid"], as_index=False)
+               .agg(time_min=("time_", "min"), time_max=("time_", "max"),
+                    avg_upid_rss=("rss_bytes", "mean"),
+                    avg_upid_vsz=("vsize_bytes", "mean"),
+                    cu_max=("cpu_utime_ns", "max"),
+                    cu_min=("cpu_utime_ns", "min"),
+                    ck_max=("cpu_ktime_ns", "max"),
+                    ck_min=("cpu_ktime_ns", "min")))
+        per["cu"] = per["cu_max"] - per["cu_min"]
+        per["ck"] = per["ck_max"] - per["ck_min"]
+        pods = (per.groupby(["service", "pod"], as_index=False)
+                .agg(time_min=("time_min", "min"),
+                     time_max=("time_max", "max"),
+                     cpu_ktime_ns=("ck", "sum"), cpu_utime_ns=("cu", "sum"),
+                     avg_rss=("avg_upid_rss", "sum"),
+                     avg_vsz=("avg_upid_vsz", "sum")))
+        pods["tw"] = pods["time_max"] - pods["time_min"]
+        pods["cpu_usage"] = (pods["cpu_ktime_ns"]
+                             + pods["cpu_utime_ns"]) / pods["tw"]
+        svc = (pods.groupby("service", as_index=False)
+               .agg(avg_pod_cpu=("cpu_usage", "mean"),
+                    avg_pod_rss=("avg_rss", "mean"),
+                    pod_count=("pod", "count"),
+                    time_window=("tw", "max")))
+        # http side (inbound server-side traffic)
+        h = since(tdf("http_events"), 600).copy()
+        h["service"] = h["upid"].map(q_svc)
+        h = h[(h["service"] != "") & (h["trace_role"] == 2)]
+        hl = h.groupby("service", as_index=False).agg(
+            http_throughput_total=("latency", "count"))
+        lat = h.groupby("service")["latency"]
+        svc = svc.merge(hl, on="service", how="left")
+        svc["http_request_throughput"] = (
+            svc["http_throughput_total"] / svc["time_window"])
+        got = res.to_pandas()
+        assert set(got.columns) == {
+            "service", "pod_count", "avg_pod_cpu", "avg_pod_rss",
+            "http_request_throughput", "http_latency"}
+        gs = got.sort_values("service").reset_index(drop=True)
+        es = svc.sort_values("service").reset_index(drop=True)
+        assert gs["service"].tolist() == es["service"].tolist()
+        assert gs["pod_count"].tolist() == es["pod_count"].tolist()
+        np.testing.assert_allclose(gs["avg_pod_cpu"], es["avg_pod_cpu"],
+                                   rtol=1e-9)
+        np.testing.assert_allclose(gs["avg_pod_rss"], es["avg_pod_rss"],
+                                   rtol=1e-9)
+        np.testing.assert_allclose(
+            gs["http_request_throughput"],
+            es["http_request_throughput"], rtol=1e-9)
+        # http_latency is the ST_QUANTILES json: check p50 within sketch tol
+        p50_exact = lat.apply(lambda s: np.quantile(
+            np.asarray(s, float), 0.5, method="inverted_cdf"))
+        for svc_name, blob in zip(gs["service"], gs["http_latency"]):
+            p50 = json.loads(blob)["p50"]
+            np.testing.assert_allclose(
+                p50, p50_exact[svc_name], rtol=0.05)
+
+    def test_perf_flamegraph_stacktraces(self):
+        import os
+        import socket
+
+        res = one_result(run_func(
+            "perf_flamegraph", "stacktraces",
+            {"start_time": "-5m", "node": "", "namespace": "", "pod": "",
+             "pct_basis_entity": "node"}))
+        snap = _snap()
+        df = since(tdf("stack_traces.beta"), 300).copy()
+        df["namespace"] = df["upid"].map(q_ns)
+        df["pod"] = df["upid"].map(q_pod)
+        df["container"] = df["upid"].map(
+            lambda u: snap.containers_by_id[
+                snap.upid_to_container_id[u]].name
+            if u in snap.upid_to_container_id else "")
+        df["cmdline"] = df["upid"].map(q_cmdline)
+        # px._exec_hostname() is the executing AGENT's node name (the
+        # metadata identity), not the raw OS hostname
+        df["node"] = "node-1"
+        ncpu = os.cpu_count() or 1
+        total = df.groupby("node")["count"].sum()  # BEFORE the pod filter
+        df = df[df["pod"] != ""]
+        g = (df.groupby(["node", "namespace", "pod", "container", "cmdline",
+                         "stack_trace_id"], as_index=False)
+             .agg(stack_trace=("stack_trace", "min"),
+                  count=("count", "sum")))
+        g["count_x"] = g["node"].map(total)
+        g["scaling_factor"] = ncpu
+        g["percent"] = 100.0 * g["count"] * ncpu / g["count_x"]
+        # the script's `df.drop('node_x')` is unassigned — a no-op — so the
+        # merge suffix column survives in the reference output too
+        g["node_x"] = g["node"]
+        exp = g[["node", "namespace", "pod", "container", "cmdline",
+                 "stack_trace_id", "stack_trace", "count", "count_x",
+                 "scaling_factor", "percent", "node_x"]]
+        assert_frames(res, exp, approx=("percent",), rtol=1e-9)
+
+
+class TestKafkaScripts:
+    """The demo kafka req/resp bodies carry no kafka JSON (pluck returns
+    ''), so the rebalancing/latency pipelines must produce exactly-empty,
+    schema-complete results — same contract the engine must honor on a
+    cluster with no kafka traffic."""
+
+    def test_kafka_consumer_rebalancing_group_ids_empty(self):
+        res = one_result(run_func(
+            "kafka_consumer_rebalancing", "kafka_group_ids",
+            {"start_time": "-5m"}))
+        assert res.num_rows == 0
+        assert set(res.relation.names()) == {"group_id", "num_members"}
+
+    def test_kafka_overview_topics_empty(self):
+        res = one_result(run_func(
+            "kafka_overview", "kafka_topics_overview",
+            {"start_time": "-5m", "ns": "", "topic": ""}))
+        assert res.num_rows == 0
+
+    def test_kafka_producer_consumer_latency_topics_empty(self):
+        res = one_result(run_func(
+            "kafka_producer_consumer_latency", "kafka_topics",
+            {"start_time": "-5m", "namespace": "default"}))
+        assert res.num_rows == 0
+
+
+class TestIpScript:
+    def test_ip_pod_traffic(self):
+        # pod_traffic_to_ip: conn_stats rows from pods talking to the IP
+        res = one_result(run_func(
+            "ip", "pod_traffic_to_ip",
+            {"start_time": "-5m", "ip": "192.168.9.9"}))
+        snap = _snap()
+        df = since(tdf("conn_stats"), 300).copy()
+        df = df[df["remote_addr"] == "192.168.9.9"]
+        df["pod"] = df["upid"].map(q_pod)
+        df["node"] = df["upid"].map(
+            lambda u: snap.pod_of_upid(u).node if snap.pod_of_upid(u)
+            else "")
+        df["service"] = df["upid"].map(q_svc)
+        g = (df.groupby(["pod", "node", "service", "upid", "trace_role"],
+                        as_index=False)
+             .agg(bs_min=("bytes_sent", "min"), bs_max=("bytes_sent", "max"),
+                  br_min=("bytes_recv", "min"), br_max=("bytes_recv", "max")))
+        g["sent"] = g["bs_max"] - g["bs_min"]
+        g["recv"] = g["br_max"] - g["br_min"]
+        g["total"] = g["sent"] + g["recv"]
+        mid = (g.groupby(["pod", "node", "service", "trace_role"],
+                         as_index=False)
+               .agg(sent=("sent", "sum"), recv=("recv", "sum"),
+                    total=("total", "sum")))
+        delta = int(df["time_"].max() - df["time_"].min())
+        mid["bytes_per_s_from_ip"] = mid["recv"] / delta
+        mid["bytes_per_s_to_ip"] = mid["sent"] / delta
+        mid["total_bytes_per_s"] = mid["total"] / delta
+        out = (mid.groupby("pod", as_index=False)
+               .agg(bytes_per_s_from_ip=("bytes_per_s_from_ip", "sum"),
+                    bytes_per_s_to_ip=("bytes_per_s_to_ip", "sum"),
+                    total_bytes_per_s=("total_bytes_per_s", "sum")))
+        assert_frames(res, out,
+                      approx=("bytes_per_s_from_ip", "bytes_per_s_to_ip",
+                              "total_bytes_per_s"), rtol=1e-9)

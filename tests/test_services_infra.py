@@ -195,6 +195,50 @@ def test_leader_election_acquire_renew_steal():
     assert a.leader() == "a"
 
 
+def test_healthz_stop_before_start_does_not_hang():
+    srv = HealthzServer()
+    srv.stop()  # must return immediately, not block on shutdown()
+
+
+def test_standby_passes_healthz_but_fails_readyz():
+    """Leadership is a READINESS concern: a healthy standby must return 200
+    on /healthz (else a liveness probe would restart it in a loop) and 503
+    on /readyz."""
+    kv = KVStore(":memory:")
+    leader = LeaderElector(kv, "broker", "b1", ttl_s=5.0)
+    standby = LeaderElector(kv, "broker", "b2", ttl_s=5.0)
+    leader.try_acquire()
+    standby.try_acquire()
+    srv = HealthzServer(
+        checks={"server": lambda: True},
+        ready_checks={"leader": standby.is_leader}).start()
+    try:
+        code, _ = _get(srv.port, "/healthz")
+        assert code == 200
+        code, body = _get(srv.port, "/readyz")
+        assert code == 503
+        assert json.loads(body)["checks"]["leader"] == "failed"
+    finally:
+        srv.stop()
+
+
+def test_broker_failed_init_leaks_nothing():
+    """A constructor raise (election over :memory:) must not leave a bound
+    server socket behind."""
+    from pixie_tpu.status import InvalidArgument
+
+    with pytest.raises(InvalidArgument):
+        Broker(election_id="b1")
+    # constructing again on the same fixed port would fail if the socket
+    # leaked; use a fixed port twice to prove cleanliness
+    b = Broker(port=0)
+    port = b.port
+    b.stop()
+    b2 = Broker(port=port)
+    assert b2.port == port
+    b2.stop()
+
+
 def test_kv_cas_is_atomic_compare_and_set():
     kv = KVStore(":memory:")
     assert kv.cas("k", None, b"v1") is True
