@@ -483,12 +483,16 @@ class ChainKernel:
         self.registry = registry
         self.time_col = time_col
         self.steps = []  # ("map", op) applied symbolically; ("filter", sval); ("limit", i)
+        #: True when any MapOp rewrote the symbolic env — raw source columns
+        #: then no longer equal the chain's outputs (np_partial eligibility)
+        self.has_map = False
         #: per-LimitOp budgets, in chain order — each limit step tracks its OWN
         #: remaining budget (a single min-collapsed budget under-returns when a
         #: filter between two limits drops admitted rows).
         self.limit_ns: list[int] = []
         for op in transforms:
             if isinstance(op, MapOp):
+                self.has_map = True
                 self.ctx.apply_map(op)
             elif isinstance(op, FilterOp):
                 self.steps.append(("filter", self.ctx.compile_predicate(op)))
@@ -897,6 +901,42 @@ def _state_packer(sample_state):
         _PACK_CACHE.clear()
     _PACK_CACHE[key] = got
     return got
+
+
+@dataclasses.dataclass
+class _FinalizedCol:
+    """An output column finalized ON DEVICE and already pulled: the agg
+    finalize step must run finalize_from_device on it instead of
+    finalize_host on state bytes."""
+
+    col: np.ndarray
+
+
+#: jitted merge(+device finalize) of per-feed partials, keyed by the agg's
+#: UDA spec — the single execution that replaces N per-feed state pulls +
+#: a host merge with one small readback wave
+_MERGE_FINALIZE_CACHE: dict = {}
+
+
+def _merge_finalize_fn(spec_key, reduce_tree, udas_by_name,
+                       finalize_ok: bool = True):
+    fn = _MERGE_FINALIZE_CACHE.get(spec_key)
+    if fn is None:
+        merge = ChainKernel.merge_states_fn(reduce_tree)
+        fin = {name: uda for name, uda in udas_by_name.items()
+               if finalize_ok and uda.device_finalize}
+
+        def run(*states):
+            merged = merge(*states) if len(states) > 1 else states[0]
+            finals = {k: fin[k].finalize_device(merged[k]) for k in fin}
+            rest = {k: v for k, v in merged.items() if k not in fin}
+            return finals, rest
+
+        fn = jax.jit(run)
+        if len(_MERGE_FINALIZE_CACHE) > 64:
+            _MERGE_FINALIZE_CACHE.clear()
+        _MERGE_FINALIZE_CACHE[spec_key] = fn
+    return fn
 
 
 #: jitted cross-agent state merges, keyed by (layout_fp, arity) — a fresh
@@ -1811,11 +1851,26 @@ class PlanExecutor:
                 ([head.id] if head.id >= 0 else []) + [o.id for o in chain],
             ) as rec:
                 self._feed_rec = rec if self.analyze else None
-                state_np = self._agg_feed_loop(
-                    kern, step, partial_step, merge_fn, spmd_step,
-                    init_specs, num_groups,
-                    src, names, cap, t_lo, t_hi, luts,
-                )
+                from pixie_tpu.engine import np_partial
+
+                if (self._backend_for(src) == "cpu" and spmd_step is None
+                        and np_partial.eligible(kern, keys, udas, val_dicts)
+                        and np_partial.value_args_ok(kern, op, names)):
+                    # CPU streaming/poll fast path: bincount-shaped numpy +
+                    # native histogram scatter at memory speed, identical
+                    # state layout (see np_partial module docstring)
+                    state_np = np_partial.run(
+                        self, src, names, cap, kern, keys, init_specs,
+                        num_groups, t_lo, t_hi, luts,
+                        np_partial.value_args(kern, op))
+                    self.stats["np_fast_polls"] = self.stats.get(
+                        "np_fast_polls", 0) + 1
+                else:
+                    state_np = self._agg_feed_loop(
+                        kern, step, partial_step, merge_fn, spmd_step,
+                        init_specs, num_groups,
+                        src, names, cap, t_lo, t_hi, luts,
+                    )
                 self._feed_rec = None
         return keys, udas, state_np, seen_name, in_types, val_dicts
 
@@ -1973,6 +2028,15 @@ class PlanExecutor:
             n_dev = self.mesh.size if self.mesh is not None else 1
             backend = ("tpu" if spmd_step is not None
                        else self._backend_for(src))
+            # Accelerator-backend feeds normally end in a DEVICE merge (+
+            # device finalize) with one small readback — raw states stay
+            # unpacked for it.  Packing only pays on the paths that still
+            # pull per-feed states (defer / mixed CPU partials).  The SPMD
+            # path qualifies too: its per-feed states are already in-mesh
+            # merged (replicated), and the merge+finalize jit runs under
+            # GSPMD like any other consumer.
+            device_merge_ok = (backend == "tpu"
+                               and not getattr(self, "_defer_active", False))
             for cols, n_valid in self._feed(src, names, cap,
                                             spmd=spmd_step is not None,
                                             backend=backend):
@@ -1992,12 +2056,21 @@ class PlanExecutor:
                     small_np = (isinstance(first, np.ndarray)
                                 and bucket <= CPU_CROSSOVER_ROWS
                                 and _cpu_device() is not False)
+                    if small_np and device_merge_ok:
+                        # A device-merged query keeps its small feeds (the
+                        # hot remainder) ON the accelerator: executions are
+                        # cheap async dispatches, while a CPU partial here
+                        # would force the mixed pull path — megabytes of
+                        # sketch state over the tunnel instead of one
+                        # device merge + a kilobyte readback.
+                        small_np = False
                     ctx = (jax.default_device(_cpu_device()) if small_np
                            else _contextlib.nullcontext())
                     with ctx:
                         p = partial_step(cols, np.int64(n_valid), t_lo,
                                          t_hi, luts)
                         if not small_np and backend == "tpu" \
+                                and not device_merge_ok \
                                 and not getattr(self, "_defer_active",
                                                 False):
                             # pack the multi-leaf state into one buffer per
@@ -2039,6 +2112,34 @@ class PlanExecutor:
                     if not dev:
                         return host_state
                     return _DeferredState(dev, merge_fn, host_state)
+                if device_merge_ok:
+                    # ONE device execution merges every per-feed partial and
+                    # finalizes large-state UDAs (sketch → quantiles) in
+                    # place, so the readback wave carries kilobytes of
+                    # answers instead of megabytes of state — on a tunneled
+                    # runtime (~24 MB/s, ~100 ms/pull) state bytes are the
+                    # dominant e2e cost (reference bar: zero-copy batch
+                    # handoff, exec_graph.cc:177-260).
+                    udas_by_name = {name: uda
+                                    for name, uda, _dt in init_specs}
+                    rt = {name: uda.reduce_ops()
+                          for name, uda, _dt in init_specs}
+                    # the distributed partial path ships RAW state (it must
+                    # stay mergeable across agents): device-merge the feeds
+                    # but never finalize
+                    fin_ok = not getattr(self, "_partial_wire", False)
+                    spec_key = ("mfz", fin_ok, tuple(
+                        (name, type(uda).__qualname__,
+                         getattr(uda, "q", None))
+                        for name, uda, _dt in init_specs))
+                    finals, rest = _merge_finalize_fn(
+                        spec_key, rt, udas_by_name,
+                        finalize_ok=fin_ok)(*partials)
+                    finals_np, rest_np = transfer.pull((finals, rest))
+                    out = dict(rest_np)
+                    for k, v in finals_np.items():
+                        out[k] = _FinalizedCol(v)
+                    return out
                 pulled = transfer.pull(
                     [p.buf if isinstance(p, _PackedState) else p
                      for p in partials])
@@ -2066,12 +2167,14 @@ class PlanExecutor:
         """Distributed partial path: seen groups as VALUES + raw UDA state
         (see pixie_tpu.parallel.partial.PartialAggBatch)."""
         self._defer_active = self.defer_agg_pull
+        self._partial_wire = True  # ship raw state; no device finalize
         try:
             keys, udas, state_np, seen_name, in_types, val_dicts = self._agg_state(op)
         except GroupKeyFallback:
             return self._sorted_partial_batch(op)
         finally:
             self._defer_active = False
+            self._partial_wire = False
         if val_dicts:
             raise Internal(
                 "dict-valued aggregates must ship rows, not partial state "
@@ -2234,13 +2337,14 @@ class PlanExecutor:
         for out_name, uda, _vb in udas:
             if out_name == seen_name:
                 continue
-            if getattr(uda, "needs_dict", False):
+            st = state_np[out_name]
+            if isinstance(st, _FinalizedCol):
+                full = uda.finalize_from_device(st.col)
+            elif getattr(uda, "needs_dict", False):
                 full = uda.finalize_dict(
-                    jax.tree.map(lambda x: x, state_np[out_name]),
-                    val_dicts[out_name])
+                    jax.tree.map(lambda x: x, st), val_dicts[out_name])
             else:
-                full = uda.finalize_host(
-                    jax.tree.map(lambda x: x, state_np[out_name]))
+                full = uda.finalize_host(jax.tree.map(lambda x: x, st))
             vals = np.asarray(full)[gids]
             # Use the DECLARED input DataType so e.g. min(time_) stays TIME64NS
             # (matching the compile-time schema); fall back to array inference

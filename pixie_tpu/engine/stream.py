@@ -295,7 +295,7 @@ class StreamQuery:
             for lid in pl.limit_ids:
                 pl.fragment.op(lid).n = pl.remaining[lid]
             ex = PlanExecutor(pl.fragment, self.store, self.registry,
-                              force_backend="cpu")
+                              mesh=None, force_backend="cpu")
             res = ex.run()[pl.sink_name]
             pl.token = hi
             if pl.limit_ids:
@@ -348,7 +348,7 @@ class StreamQuery:
         Caller must have set pl.source.since/stop_row_id; advances the token
         on success.  Returns the delta PartialAggBatch."""
         ex = PlanExecutor(pl.fragment, self.store, self.registry,
-                          force_backend="cpu")
+                          mesh=None, force_backend="cpu")
         pb = ex.run_agent()[self.CHANNEL]
         pl.token = pl.source.stop_row_id
         return pb

