@@ -1,0 +1,258 @@
+"""BCC-C code generation from validated pxtrace programs.
+
+Reference: src/stirling/source_connectors/dynamic_tracer/dynamic_tracing/ —
+logical probe IR flows through probe_transformer (entry/return pairing, the
+start-time map stash) and the dwarvifier (DWARF-resolved argument reads,
+dwarvifier.cc) into code_gen.cc's BCC program (struct def, BPF_PERF_OUTPUT,
+perf_submit).  This module is that pipeline for our bpftrace-dialect
+programs: parse → logical probes → (optional DWARF arg resolution for
+uprobes) → BCC C source.  Generation is deterministic, so golden-text
+tests pin the emitted program without needing a kernel (the reference's
+code_gen_test.cc pattern); the TracepointManager's probe driver consumes
+the source at attach time on hosts with BCC.
+
+Supported surface (the validated pxtrace dialect):
+  builtins  : nsecs → bpf_ktime_get_ns(), pid/tid → bpf_get_current_pid_tgid,
+              comm → bpf_get_current_comm, retval → PT_REGS_RC,
+              arg0..arg9 → PT_REGS_PARM<n+1> (or DWARF frame-base reads)
+  latency   : an entry probe stashing $t = nsecs paired with a ret probe
+              computing nsecs - $t becomes a BPF_HASH start-map (the
+              probe_transformer's entry/return pairing)
+  output    : the printf("name:%spec ...") fields become the event struct,
+              one BPF_PERF_OUTPUT per table
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+from pixie_tpu.compiler.pxtrace import (
+    _FIELD_RE,
+    _PRINTF_RE,
+    _PROBE_DECL_RE,
+    parse_program_schema,
+)
+from pixie_tpu.status import CompilerError
+from pixie_tpu.types import DataType as DT
+
+#: printf spec → C member type
+_C_TYPES = {DT.INT64: "int64_t", DT.TIME64NS: "uint64_t",
+            DT.FLOAT64: "double", DT.STRING: "char", DT.BOOLEAN: "bool"}
+_STR_LEN = 64  # fixed string capture (reference kStructStringSize analog)
+
+
+@dataclasses.dataclass
+class LogicalProbe:
+    kind: str        # kprobe | kretprobe | uprobe | uretprobe | tracepoint
+    target: str      # symbol / path:symbol / category:name
+    body: str
+
+
+def parse_probes(program: str) -> list[LogicalProbe]:
+    """Split a validated program into logical probes (decl + body)."""
+    short = {"k": "kprobe", "kr": "kretprobe", "u": "uprobe",
+             "ur": "uretprobe", "t": "tracepoint"}
+    out = []
+    decls = list(_PROBE_DECL_RE.finditer(program))
+    for i, m in enumerate(decls):
+        end = decls[i + 1].start() if i + 1 < len(decls) else len(program)
+        body = program[m.end(): end]
+        body = body[: body.rfind("}")] if "}" in body else body
+        out.append(LogicalProbe(short.get(m.group(1), m.group(1)),
+                                m.group(2), body.strip()))
+    return out
+
+
+_ASSIGN_T_RE = re.compile(r"\$(\w+)\s*=\s*nsecs")
+_LATENCY_RE = re.compile(r"nsecs\s*-\s*\$(\w+)")
+
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^0-9A-Za-z_]", "_", name)
+
+
+def _expr_for(field: str, expr: str, probe: LogicalProbe,
+              dwarf_args: Optional[dict]) -> list[str]:
+    """C statements filling `ev.<field>` from a bpftrace-dialect expr."""
+    expr = expr.strip()
+    if expr == "nsecs":
+        return [f"  ev.{field} = bpf_ktime_get_ns();"]
+    if expr == "pid":
+        return [f"  ev.{field} = bpf_get_current_pid_tgid() >> 32;"]
+    if expr == "tid":
+        return [f"  ev.{field} = (uint32_t)bpf_get_current_pid_tgid();"]
+    if expr == "comm":
+        return [f"  bpf_get_current_comm(&ev.{field}, sizeof(ev.{field}));"]
+    if expr == "retval":
+        return [f"  ev.{field} = PT_REGS_RC(ctx);"]
+    m = re.fullmatch(r"arg(\d)", expr)
+    if m:
+        n = int(m.group(1))
+        if dwarf_args is not None and n < len(dwarf_args["args"]):
+            a = dwarf_args["args"][n]
+            if a.location and a.location.startswith("fbreg"):
+                off = int(a.location[5:])
+                size = a.byte_size or 8
+                # the dwarvifier's frame-base read: at function entry the
+                # frame base (CFA) is SP+8 on x86-64
+                return [
+                    f"  bpf_probe_read(&ev.{field}, {size}, "
+                    f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
+                ]
+        return [f"  ev.{field} = PT_REGS_PARM{n + 1}(ctx);"]
+    m = re.fullmatch(r"str\(arg(\d)\)", expr)
+    if m:
+        n = int(m.group(1))
+        return [
+            f"  bpf_probe_read_str(&ev.{field}, sizeof(ev.{field}), "
+            f"(void*)PT_REGS_PARM{n + 1}(ctx));",
+        ]
+    m = _LATENCY_RE.fullmatch(expr)
+    if m:
+        return [
+            "  uint64_t* _start = start_ts.lookup(&_tid);",
+            "  if (_start == 0) { return 0; }",
+            f"  ev.{field} = bpf_ktime_get_ns() - *_start;",
+            "  start_ts.delete(&_tid);",
+        ]
+    raise CompilerError(
+        f"pxtrace codegen: unsupported capture expression {expr!r} "
+        f"for field {field!r}")
+
+
+def _probe_fn_name(probe: LogicalProbe, used: set) -> str:
+    base = _sanitize(probe.target.split(":")[-1])
+    name = f"probe_{'ret_' if probe.kind.endswith('retprobe') else ''}{base}"
+    # distinct probes can share a symbol basename (same symbol in two
+    # binaries, same tracepoint name in two categories) — dedupe or the
+    # generated C has duplicate function definitions
+    cand, i = name, 1
+    while cand in used:
+        cand = f"{name}_{i}"
+        i += 1
+    used.add(cand)
+    return cand
+
+
+def _field_exprs(body: str) -> list[tuple[str, str, str]]:
+    """printf body → [(field, spec, source expr)] pairing format fields
+    with their argument expressions positionally."""
+    m = _PRINTF_RE.search(body)
+    if not m:
+        return []
+    fmt = m.group(1)
+    fields = _FIELD_RE.findall(fmt)
+    tail = body[m.end():]
+    # split the printf tail on TOP-LEVEL commas until the depth-0 ')'
+    # (an arg like str(arg2) contains nested parens)
+    args, cur, depth = [], "", 0
+    for ch in tail:
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            if depth == 0:
+                break
+            depth -= 1
+        if ch == "," and depth == 0:
+            if cur.strip():
+                args.append(cur.strip())
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        args.append(cur.strip())
+    if len(args) != len(fields):
+        raise CompilerError(
+            f"pxtrace codegen: {len(fields)} format fields but "
+            f"{len(args)} arguments")
+    return [(name, spec, arg) for (name, spec), arg in zip(fields, args)]
+
+
+def generate_bcc(name: str, table_name: str, program: str,
+                 dwarf_path: Optional[str] = None) -> str:
+    """Validated pxtrace program → complete BCC C program text.
+
+    dwarf_path: binary to resolve uprobe argument locations against (the
+    dwarvifier pass); falls back to calling-convention registers.
+    """
+    probes = parse_probes(program)
+    if not probes:
+        raise CompilerError("pxtrace codegen: program declares no probes")
+    rel = parse_program_schema(program)
+
+    # entry/return latency pairing (probe_transformer analog)
+    stash_var = None
+    for p in probes:
+        m = _ASSIGN_T_RE.search(p.body)
+        if m and not p.kind.endswith("retprobe"):
+            stash_var = m.group(1)
+
+    struct_name = f"{_sanitize(table_name)}_event_t"
+    lines = [
+        f"// generated by pixie-tpu pxtrace codegen: tracepoint {name!r}",
+        "#include <uapi/linux/ptrace.h>",
+        "",
+        f"struct {struct_name} {{",
+    ]
+    for c in rel:
+        ctype = _C_TYPES[c.data_type]
+        suffix = f"[{_STR_LEN}]" if c.data_type == DT.STRING else ""
+        lines.append(f"  {ctype} {c.name}{suffix};")
+    lines += [
+        "};",
+        "",
+        f"BPF_PERF_OUTPUT({_sanitize(table_name)});",
+    ]
+    if stash_var is not None:
+        lines.append("BPF_HASH(start_ts, uint32_t, uint64_t);")
+    lines.append("")
+
+    dwarf_cache: dict[str, object] = {}
+    used_fn_names: set = set()
+    for p in probes:
+        fn = _probe_fn_name(p, used_fn_names)
+        lines.append(f"// {p.kind}:{p.target}")
+        lines.append(f"int {fn}(struct pt_regs* ctx) {{")
+        needs_tid = (stash_var is not None)
+        if needs_tid:
+            lines.append(
+                "  uint32_t _tid = (uint32_t)bpf_get_current_pid_tgid();")
+        if stash_var is not None and _ASSIGN_T_RE.search(p.body) \
+                and not p.kind.endswith("retprobe"):
+            lines += [
+                "  uint64_t _now = bpf_ktime_get_ns();",
+                "  start_ts.update(&_tid, &_now);",
+            ]
+        fields = _field_exprs(p.body)
+        if fields:
+            dw = None
+            # DWARF frame-base reads are only valid at function ENTRY (the
+            # frame is gone at return — the reference's probe_transformer
+            # moves entry-arg captures to the entry probe and stashes them)
+            if p.kind == "uprobe" and ":" in p.target:
+                import os
+
+                path, sym = p.target.rsplit(":", 1)
+                if dwarf_path or os.path.isfile(path):
+                    binpath = dwarf_path or path
+                    try:
+                        if binpath not in dwarf_cache:
+                            from pixie_tpu.obj_tools.dwarf_reader import (
+                                DwarfReader,
+                            )
+
+                            dwarf_cache[binpath] = DwarfReader(binpath)
+                        dw = {"args": dwarf_cache[binpath].function_args(sym)}
+                    except (ValueError, KeyError, OSError):
+                        dw = None
+            lines.append(f"  struct {struct_name} ev = {{}};")
+            for field, _spec, expr in fields:
+                lines += _expr_for(field, expr, p, dw)
+            lines.append(
+                f"  {_sanitize(table_name)}.perf_submit(ctx, &ev, "
+                f"sizeof(ev));")
+        lines.append("  return 0;")
+        lines.append("}")
+        lines.append("")
+    return "\n".join(lines)

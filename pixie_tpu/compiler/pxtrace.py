@@ -126,23 +126,31 @@ def validate_program(program: str, probe_kind: str) -> None:
     # a $var assigned only in probe A must not validate a use in probe B —
     # so split the program into probe bodies first and scan each with a
     # fresh assignment set (bpftrace reference manual, scratch variables).
-    starts = [m.start() for m in _PROBE_DECL_RE.finditer(stripped)]
-    bodies = []
-    if starts:
-        # text before the first declaration (BEGIN/END blocks, map setup)
-        # still gets scanned; each probe's slice runs from its OWN
-        # declaration start (so its /predicate/ $vars are checked under its
-        # scope — predicates evaluate before the body, hence before any
-        # assignment) to the next declaration's start.
-        if stripped[:starts[0]].strip():
-            bodies.append(stripped[:starts[0]])
-        for i, s in enumerate(starts):
-            nxt = starts[i + 1] if i + 1 < len(starts) else len(stripped)
-            bodies.append(stripped[s:nxt])
+    # ONE dialect extension: a RETURN probe may reference a $var assigned in
+    # the ENTRY probe of the SAME target — the entry/return latency pairing
+    # that codegen lowers to a BPF_HASH start-map stash (the reference's
+    # probe_transformer.cc inserts exactly this stash).
+    matches = list(_PROBE_DECL_RE.finditer(stripped))
+    chunks = []  # (kind, target, body text incl. own decl/predicate)
+    if matches:
+        if stripped[:matches[0].start()].strip():
+            chunks.append((None, None, stripped[:matches[0].start()]))
+        for i, m in enumerate(matches):
+            nxt = (matches[i + 1].start() if i + 1 < len(matches)
+                   else len(stripped))
+            chunks.append((short.get(m.group(1), m.group(1)), m.group(2),
+                           stripped[m.start():nxt]))
     else:
-        bodies = [stripped]
-    for body in bodies:
+        chunks = [(None, None, stripped)]
+    entry_assigned: dict[str, set] = {}  # target -> $vars set in entry probe
+    for kind, target, body in chunks:
+        if kind in ("kprobe", "uprobe", "tracepoint", "usdt"):
+            entry_assigned.setdefault(target, set()).update(
+                _ASSIGN_RE.findall(body))
+    for kind, target, body in chunks:
         assigned: set[str] = set()
+        if kind in ("kretprobe", "uretprobe"):
+            assigned |= entry_assigned.get(target, set())
         for stmt in re.split(r"[;{}]", body):
             for name in _ASSIGN_RE.findall(stmt):
                 assigned.add(name)
@@ -231,6 +239,18 @@ class PxTraceModule(types.ModuleType):
         if ttl_ns <= 0:
             raise CompilerError("UpsertTracepoint: ttl must be positive")
         self._ctx.schemas[table_name] = rel
+        # Best-effort BCC code generation at COMPILE time (reference:
+        # dynamic_tracing code_gen.cc runs agent-side; generating here lets
+        # the compiler reject unsupported captures early and ships ready
+        # program text to drivers).  Programs using bpftrace features the
+        # generator doesn't cover still deploy with the raw program only.
+        bcc_source = None
+        try:
+            from pixie_tpu.compiler.probe_codegen import generate_bcc
+
+            bcc_source = generate_bcc(name, table_name, program)
+        except CompilerError:
+            pass
         self._ctx.mutations.append({
             "kind": "tracepoint",
             "name": name,
@@ -239,6 +259,7 @@ class PxTraceModule(types.ModuleType):
             "probe": probe.kind,
             "ttl_ns": ttl_ns,
             "schema": rel.to_dict(),
+            "bcc_source": bcc_source,
         })
 
     def DeleteTracepoint(self, name: str) -> None:

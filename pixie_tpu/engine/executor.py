@@ -483,16 +483,12 @@ class ChainKernel:
         self.registry = registry
         self.time_col = time_col
         self.steps = []  # ("map", op) applied symbolically; ("filter", sval); ("limit", i)
-        #: True when any MapOp rewrote the symbolic env — raw source columns
-        #: then no longer equal the chain's outputs (np_partial eligibility)
-        self.has_map = False
         #: per-LimitOp budgets, in chain order — each limit step tracks its OWN
         #: remaining budget (a single min-collapsed budget under-returns when a
         #: filter between two limits drops admitted rows).
         self.limit_ns: list[int] = []
         for op in transforms:
             if isinstance(op, MapOp):
-                self.has_map = True
                 self.ctx.apply_map(op)
             elif isinstance(op, FilterOp):
                 self.steps.append(("filter", self.ctx.compile_predicate(op)))

@@ -131,6 +131,7 @@ def update_state(state, init_specs, gid, mask, vals_by_name, num_groups,
     out = dict(state)
     counts = None  # shared count-by-gid for count/mean
     hist_bins = {}  # value-column name -> bin codes (shared across sketches)
+    order = starts = gs = None  # shared argsort for min/max/any
     for name, uda, _in_dt in init_specs:
         v = vals_by_name.get(name)
         if isinstance(uda, CountUDA):
@@ -178,10 +179,13 @@ def update_state(state, init_specs, gid, mask, vals_by_name, num_groups,
         elif isinstance(uda, (MinUDA, MaxUDA, AnyUDA)):
             vm = v[sel].astype(out[name].dtype, copy=False)
             # sort-based segmented extremum: orders of magnitude faster than
-            # np.minimum.at's per-element dispatch
-            order = np.argsort(g, kind="stable")
-            gs, vs = g[order], vm[order]
-            starts = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1]])
+            # np.minimum.at's per-element dispatch; the argsort is shared
+            # across every min/max/any in the aggregate
+            if order is None:
+                order = np.argsort(g, kind="stable")
+                gs = g[order]
+                starts = np.flatnonzero(np.r_[True, gs[1:] != gs[:-1]])
+            vs = vm[order]
             op = (np.minimum if isinstance(uda, (MinUDA, AnyUDA))
                   else np.maximum)
             seg = (np.minimum.reduceat(vs, starts)
