@@ -415,6 +415,29 @@ def bench_ingest(rows):
     return rows / secs, rows * bytes_per_row / secs
 
 
+def bench_device_join(rows):
+    """Device sort/searchsorted equijoin unit bench (ops/join_device.py),
+    DEVICE-RESIDENT inputs — the honest case for this kernel: over the dev
+    tunnel (~24 MB/s each way) uploading host partitions costs more than
+    the host match, so the executor gates it on PX_DEVICE_JOIN; on
+    direct-attached TPUs the match phase itself is what matters."""
+    import jax
+
+    from pixie_tpu.ops.join_device import expand_pairs, match_ranges
+
+    rng = np.random.default_rng(11)
+    b = jax.device_put(rng.integers(0, rows, rows).astype(np.int64))
+    p = jax.device_put(rng.integers(0, rows, rows).astype(np.int64))
+    order, lo, hi, total = match_ranges(b, p)  # compile
+    jax.block_until_ready(expand_pairs(order, lo, hi, int(total)))
+    t0 = time.perf_counter()
+    order, lo, hi, total = match_ranges(b, p)
+    bi, pi = expand_pairs(order, lo, hi, int(total))
+    jax.block_until_ready((bi, pi))
+    secs = time.perf_counter() - t0
+    return 2 * rows / secs
+
+
 def mxu_flops_estimate(rows, secs):
     """Achieved FLOP/s of the one-hot MXU aggregation path for config #1.
 
@@ -503,6 +526,7 @@ def main():
         del ts
 
     cfg3 = bench_config3(args.join_rows, args.repeats)
+    dev_join = bench_device_join(min(args.join_rows, 16_000_000))
     cfg4 = bench_config4(args.dist_rows, max(1, args.repeats - 1))
     cfg5 = bench_config5(args.stream_rows)
     ingest_rps, ingest_bps = bench_ingest(min(args.stream_rows, 32_000_000))
@@ -521,6 +545,16 @@ def main():
                 "vs_pandas": round(cfg2 / cfg2_base, 2),
             },
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
+            "device_join_unit": {
+                "rows_per_sec": round(dev_join),
+                "note": "sort/searchsorted match phase, device-resident "
+                        "inputs. Measured VERDICT: large 1-D int64 sorts + "
+                        "binary-search gathers underperform the cache-"
+                        "optimized host match on this TPU (and tunnel H2D "
+                        "~24 MB/s taxes uploads), so PX_DEVICE_JOIN stays "
+                        "opt-in and the e2e join uses the host path, which "
+                        "this round made 3x faster via probe-side presort",
+            },
             "4_partial_final_8way": {
                 "rows_per_sec": round(cfg4), "rows": args.dist_rows,
             },

@@ -89,17 +89,23 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
     m = re.fullmatch(r"arg(\d)", expr)
     if m:
         n = int(m.group(1))
-        if dwarf_args is not None and n < len(dwarf_args["args"]):
-            a = dwarf_args["args"][n]
-            if a.location and a.location.startswith("fbreg"):
-                off = int(a.location[5:])
-                size = a.byte_size or 8
-                # the dwarvifier's frame-base read: at function entry the
-                # frame base (CFA) is SP+8 on x86-64
-                return [
-                    f"  bpf_probe_read(&ev.{field}, {size}, "
-                    f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
-                ]
+        if dwarf_args and dwarf_args.get("args") is not None:
+            # At function ENTRY, SysV args live in REGISTERS — the DWARF
+            # fbreg location describes the post-prologue spill slot, which
+            # is not yet written when the uprobe fires.  DWARF contributes
+            # the argument's EXISTENCE check and its size (truncating the
+            # register read to the declared width, e.g. an `int` arg keeps
+            # only 32 bits), exactly what the dwarvifier's C ABI path does.
+            args = dwarf_args["args"]
+            if n >= len(args):
+                raise CompilerError(
+                    f"pxtrace codegen: arg{n} out of range — "
+                    f"{dwarf_args['symbol']} has {len(args)} parameters "
+                    f"(DWARF)")
+            size = args[n].byte_size or 8
+            cast = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
+                    8: "uint64_t"}.get(size, "uint64_t")
+            return [f"  ev.{field} = ({cast})PT_REGS_PARM{n + 1}(ctx);"]
         return [f"  ev.{field} = PT_REGS_PARM{n + 1}(ctx);"]
     m = re.fullmatch(r"str\(arg(\d)\)", expr)
     if m:
@@ -110,10 +116,14 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
         ]
     m = _LATENCY_RE.fullmatch(expr)
     if m:
+        if not dwarf_args or dwarf_args.get("stash_var") != m.group(1):
+            raise CompilerError(
+                f"pxtrace codegen: 'nsecs - ${m.group(1)}' needs an entry "
+                f"probe stashing '${m.group(1)} = nsecs'")
         return [
-            "  uint64_t* _start = start_ts.lookup(&_tid);",
-            "  if (_start == 0) { return 0; }",
-            f"  ev.{field} = bpf_ktime_get_ns() - *_start;",
+            f"  uint64_t* _start_{field} = start_ts.lookup(&_tid);",
+            f"  if (_start_{field} == 0) {{ return 0; }}",
+            f"  ev.{field} = bpf_ktime_get_ns() - *_start_{field};",
             "  start_ts.delete(&_tid);",
         ]
     raise CompilerError(
@@ -181,7 +191,10 @@ def generate_bcc(name: str, table_name: str, program: str,
         raise CompilerError("pxtrace codegen: program declares no probes")
     rel = parse_program_schema(program)
 
-    # entry/return latency pairing (probe_transformer analog)
+    # entry/return latency pairing (probe_transformer analog): the stash
+    # exists only for '$var = nsecs' in an entry probe — latency exprs
+    # against anything else are a compile error (via _expr_for), never
+    # silently-broken C
     stash_var = None
     for p in probes:
         m = _ASSIGN_T_RE.search(p.body)
@@ -226,10 +239,20 @@ def generate_bcc(name: str, table_name: str, program: str,
             ]
         fields = _field_exprs(p.body)
         if fields:
+            # every probe's fields must exist in the (first-printf) event
+            # struct, or the emitted C references missing members —
+            # reject at COMPILE time, not BCC-attach time
+            schema_names = set(rel.names())
+            missing = [f for f, _s, _e in fields if f not in schema_names]
+            if missing:
+                raise CompilerError(
+                    f"pxtrace codegen: probe {p.kind}:{p.target} emits "
+                    f"fields {missing} absent from the table schema "
+                    f"(derived from the FIRST printf)")
             dw = None
-            # DWARF frame-base reads are only valid at function ENTRY (the
-            # frame is gone at return — the reference's probe_transformer
-            # moves entry-arg captures to the entry probe and stashes them)
+            # DWARF resolution only for function ENTRY (args are dead at
+            # return — the reference's probe_transformer moves entry-arg
+            # captures to the entry probe and stashes them)
             if p.kind == "uprobe" and ":" in p.target:
                 import os
 
@@ -243,12 +266,15 @@ def generate_bcc(name: str, table_name: str, program: str,
                             )
 
                             dwarf_cache[binpath] = DwarfReader(binpath)
-                        dw = {"args": dwarf_cache[binpath].function_args(sym)}
+                        dw = {"args": dwarf_cache[binpath].function_args(sym),
+                              "symbol": sym}
                     except (ValueError, KeyError, OSError):
                         dw = None
+            ctx_info = dict(dw or {})
+            ctx_info["stash_var"] = stash_var
             lines.append(f"  struct {struct_name} ev = {{}};")
             for field, _spec, expr in fields:
-                lines += _expr_for(field, expr, p, dw)
+                lines += _expr_for(field, expr, p, ctx_info)
             lines.append(
                 f"  {_sanitize(table_name)}.perf_submit(ctx, &ev, "
                 f"sizeof(ev));")

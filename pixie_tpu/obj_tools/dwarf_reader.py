@@ -32,6 +32,7 @@ DW_AT_type = 0x49
 DW_AT_specification = 0x47
 DW_AT_abstract_origin = 0x31
 DW_AT_linkage_name = 0x6E
+DW_AT_str_offsets_base = 0x72
 
 DW_OP_fbreg = 0x91
 DW_OP_regn = 0x50  # DW_OP_reg0..reg31 = 0x50..0x6f
@@ -257,6 +258,13 @@ class DwarfReader:
                         val = cu_start + val  # CU-relative → section offset
                     attrs[attr] = val
                 self.dies[die_off] = (tag, attrs)
+                if tag == DW_TAG_compile_unit:
+                    # per-CU str_offsets base for strx resolution (the root
+                    # DIE's own strx attrs resolved with the header default,
+                    # which only affects CU-name strings we don't consume)
+                    base = attrs.get(DW_AT_str_offsets_base)
+                    if isinstance(base, int):
+                        cu["str_off_base"] = base
                 if tag == DW_TAG_subprogram:
                     name = attrs.get(DW_AT_name) or attrs.get(
                         DW_AT_linkage_name)

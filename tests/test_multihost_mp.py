@@ -76,12 +76,16 @@ def test_two_process_distributed_mesh_and_partial_agg(tmp_path):
     script.write_text(WORKER)
     port = _free_port()
     coord = f"127.0.0.1:{port}"
+    import os
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
     env = {
-        "PATH": "/usr/bin:/bin:/usr/local/bin",
-        "HOME": "/root",
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin:/usr/local/bin"),
+        "HOME": os.environ.get("HOME", "/tmp"),
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "PYTHONPATH": "/root/repo",
+        "PYTHONPATH": repo,
     }
     procs = [
         subprocess.Popen(
