@@ -70,8 +70,19 @@ void px_window_agg(int64_t n, const int64_t* time_ns, int64_t w, int64_t t0,
                    float inv_log_gamma, float min_value, int64_t* counts,
                    double* sums, float* hist) {
   const int32_t hi = (int32_t)width - 1;
+  // telemetry time is (near-)sorted: track the current window's [lo, hi)
+  // bounds and divide only when a row leaves it — one 64-bit division per
+  // window CHANGE instead of per row (the div was ~60 cycles/row, the
+  // dominant cost of this loop at 8M rows/poll)
+  int64_t cur_bin = 0, bin_lo = 1, bin_hi = 0;  // empty range forces init
   for (int64_t i = 0; i < n; ++i) {
-    int64_t g = time_ns[i] / w - t0;
+    const int64_t t = time_ns[i];
+    if (t < bin_lo || t >= bin_hi) {
+      cur_bin = t / w;
+      bin_lo = cur_bin * w;
+      bin_hi = bin_lo + w;
+    }
+    int64_t g = cur_bin - t0;
     if (g < 0) g = 0;
     if (g >= G) g = G - 1;
     if (counts) counts[g] += 1;

@@ -90,22 +90,42 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
     if m:
         n = int(m.group(1))
         if dwarf_args and dwarf_args.get("args") is not None:
-            # At function ENTRY, SysV args live in REGISTERS — the DWARF
-            # fbreg location describes the post-prologue spill slot, which
-            # is not yet written when the uprobe fires.  DWARF contributes
-            # the argument's EXISTENCE check and its size (truncating the
-            # register read to the declared width, e.g. an `int` arg keeps
-            # only 32 bits), exactly what the dwarvifier's C ABI path does.
+            # At function ENTRY, SysV passes args 0..5 in REGISTERS — their
+            # DWARF fbreg locations are post-prologue spill slots, not yet
+            # written when the uprobe fires, so DWARF contributes only the
+            # EXISTENCE check and the declared width (register truncation).
+            # Args 6+ are CALLER-written stack slots, already valid at
+            # entry: those we DO read through the DWARF frame-base offset
+            # (CFA == SP+8 at the entry instruction, x86-64).
             args = dwarf_args["args"]
             if n >= len(args):
+                if (dwarf_args.get("variadic") and n < 6):
+                    # varargs beyond the named params still ride registers
+                    return [f"  ev.{field} = PT_REGS_PARM{n + 1}(ctx);"]
                 raise CompilerError(
                     f"pxtrace codegen: arg{n} out of range — "
                     f"{dwarf_args['symbol']} has {len(args)} parameters "
                     f"(DWARF)")
+            if n >= 6:
+                a = args[n]
+                if a.location and a.location.startswith("fbreg"):
+                    off = int(a.location[5:])
+                    size = a.byte_size or 8
+                    return [
+                        f"  bpf_probe_read(&ev.{field}, {size}, "
+                        f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
+                    ]
+                raise CompilerError(
+                    f"pxtrace codegen: arg{n} is stack-passed but has no "
+                    f"frame-base DWARF location")
             size = args[n].byte_size or 8
             cast = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
                     8: "uint64_t"}.get(size, "uint64_t")
             return [f"  ev.{field} = ({cast})PT_REGS_PARM{n + 1}(ctx);"]
+        if n >= 6:
+            raise CompilerError(
+                f"pxtrace codegen: arg{n} is stack-passed on x86-64; "
+                f"capturing it needs DWARF info for the target binary")
         return [f"  ev.{field} = PT_REGS_PARM{n + 1}(ctx);"]
     m = re.fullmatch(r"str\(arg(\d)\)", expr)
     if m:
@@ -120,12 +140,18 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
             raise CompilerError(
                 f"pxtrace codegen: 'nsecs - ${m.group(1)}' needs an entry "
                 f"probe stashing '${m.group(1)} = nsecs'")
-        return [
-            f"  uint64_t* _start_{field} = start_ts.lookup(&_tid);",
-            f"  if (_start_{field} == 0) {{ return 0; }}",
-            f"  ev.{field} = bpf_ktime_get_ns() - *_start_{field};",
-            "  start_ts.delete(&_tid);",
-        ]
+        out = []
+        if not dwarf_args.get("lat_emitted"):
+            # lookup ONCE per probe; the delete happens before perf_submit
+            # (a per-field delete would NULL the second latency field's
+            # lookup and silently drop every event)
+            out += [
+                "  uint64_t* _start = start_ts.lookup(&_tid);",
+                "  if (_start == 0) { return 0; }",
+            ]
+            dwarf_args["lat_emitted"] = True
+        out.append(f"  ev.{field} = bpf_ktime_get_ns() - *_start;")
+        return out
     raise CompilerError(
         f"pxtrace codegen: unsupported capture expression {expr!r} "
         f"for field {field!r}")
@@ -272,9 +298,17 @@ def generate_bcc(name: str, table_name: str, program: str,
                         dw = None
             ctx_info = dict(dw or {})
             ctx_info["stash_var"] = stash_var
+            if dw is not None:
+                try:
+                    ctx_info["variadic"] = dwarf_cache[
+                        binpath].function_is_variadic(sym)
+                except Exception:
+                    ctx_info["variadic"] = False
             lines.append(f"  struct {struct_name} ev = {{}};")
             for field, _spec, expr in fields:
                 lines += _expr_for(field, expr, p, ctx_info)
+            if ctx_info.get("lat_emitted"):
+                lines.append("  start_ts.delete(&_tid);")
             lines.append(
                 f"  {_sanitize(table_name)}.perf_submit(ctx, &ev, "
                 f"sizeof(ev));")

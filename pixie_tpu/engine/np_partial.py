@@ -58,14 +58,20 @@ def eligible(kern, keys, udas, val_dicts) -> bool:
     """True if this agg can run through the numpy partial loop.  Maps are
     fine as long as every column the loop READS is a pass-through of a
     source column (window binning is already planner-resolved into the
-    GroupKey)."""
+    GroupKey).  Chains with filter/limit steps use the jitted kernel path:
+    measured, the cached XLA kernel beats eager numpy once predicates are
+    involved (this loop's edge is the scatter-free bincount shapes)."""
     if kern.steps or kern.has_limit or val_dicts:
         return False
     if kern.time_col is not None and source_col(
             kern, kern.time_col) != kern.time_col:
-        # a map REWROTE the time column: the kernel path masks/bins on the
-        # post-map values, this loop reads raw source — semantics diverge
-        return False
+        # A map REWROTE the time column.  The kernel's WINDOW key builds on
+        # the post-map sval, this loop bins the raw source — only the
+        # planner's own `time_ = px.bin(time_, w)` rewrite is bin-
+        # equivalent to raw ((t//w*w)//w == t//w); anything else diverges.
+        wkey = next((k for k in keys if k.kind == "window"), None)
+        if wkey is None or not _is_bin_of_raw_time(kern, wkey):
+            return False
     for k in keys:
         if k.kind not in ("dict", "intdevice", "window"):
             return False
@@ -80,6 +86,21 @@ def eligible(kern, keys, udas, val_dicts) -> bool:
         if not isinstance(uda, _SUPPORTED):
             return False
     return True
+
+
+def _is_bin_of_raw_time(kern, wkey) -> bool:
+    """True when time_'s provenance is `px.bin(<raw time col>, wkey.width)`
+    (the rolling/stream planner's rewrite)."""
+    from pixie_tpu.plan.plan import Call, Column, Literal
+
+    prov = kern.ctx.provenance.get(kern.time_col)
+    if not isinstance(prov, Call) or prov.fn != "bin":
+        return False
+    if len(prov.args) != 2:
+        return False
+    col, width = prov.args
+    return (isinstance(col, Column) and col.name == kern.time_col
+            and isinstance(width, Literal) and int(width.value) == wkey.width)
 
 
 def _gid_and_mask(cols, n_valid, keys, kern, t_lo, t_hi, luts):
@@ -304,48 +325,72 @@ def _window_fused_ok(kern, keys, init_specs, value_args, t_lo, t_hi) -> bool:
     return True
 
 
-def _window_fused_feed(lh, cols, n_valid, k, t0, time_col, init_specs,
-                       value_args, num_groups, state):
-    """One px_window_agg call accumulates count+sum+hist for a feed."""
-    import ctypes
+class _FusedWindowAcc:
+    """Preallocated accumulators driven straight off STORAGE batches: the
+    native px_window_agg accumulates count+sum+hist IN PLACE per batch, so
+    a poll does zero feed coalescing, zero padding, zero masks, zero
+    intermediate arrays — and the ctypes call releases the GIL, so the
+    ingest writer runs concurrently."""
 
-    t = np.ascontiguousarray(cols[time_col][:n_valid])
-    vcol = next((a for a in value_args.values() if a is not None), None)
-    v = (np.ascontiguousarray(cols[vcol][:n_valid], dtype=np.float64)
-         if vcol is not None else np.zeros(1))
-    counts = np.zeros(num_groups, dtype=np.int64)
-    need_sum = any(isinstance(u, MeanUDA) for _n, u, _d in init_specs)
-    need_hist = any(isinstance(u, (QuantileUDA, QuantilesUDA))
-                    for _n, u, _d in init_specs)
-    sums = np.zeros(num_groups, dtype=np.float64) if need_sum else None
-    hist = (np.zeros((num_groups, lh.width), dtype=np.float32)
-            if need_hist else None)
-    lib = _native()
-    P = ctypes.POINTER
-    lib.px_window_agg(
-        ctypes.c_int64(len(t)),
-        t.ctypes.data_as(P(ctypes.c_int64)),
-        ctypes.c_int64(k.width), ctypes.c_int64(t0),
-        ctypes.c_int64(num_groups),
-        v.ctypes.data_as(P(ctypes.c_double)),
-        ctypes.c_int64(lh.width),
-        ctypes.c_float(1.0 / math.log(lh.gamma)),
-        ctypes.c_float(lh.min_value),
-        counts.ctypes.data_as(P(ctypes.c_int64)),
-        sums.ctypes.data_as(P(ctypes.c_double)) if sums is not None
-        else None,
-        hist.ctypes.data_as(P(ctypes.c_float)) if hist is not None else None,
-    )
-    out = dict(state)
-    for name, uda, _dt in init_specs:
-        if isinstance(uda, CountUDA):
-            out[name] = out[name] + counts
-        elif isinstance(uda, MeanUDA):
-            out[name] = {"sum": out[name]["sum"] + sums,
-                         "count": out[name]["count"] + counts}
+    def __init__(self, lh, k, t0, time_col, init_specs, value_args,
+                 num_groups):
+        self.lh, self.k, self.t0 = lh, k, t0
+        self.time_col = time_col
+        self.init_specs = init_specs
+        self.vcol = next((a for a in value_args.values() if a is not None),
+                         None)
+        self.num_groups = num_groups
+        self.counts = np.zeros(num_groups, dtype=np.int64)
+        self.need_sum = any(isinstance(u, MeanUDA)
+                            for _n, u, _d in init_specs)
+        self.need_hist = any(isinstance(u, (QuantileUDA, QuantilesUDA))
+                             for _n, u, _d in init_specs)
+        self.sums = (np.zeros(num_groups, dtype=np.float64)
+                     if self.need_sum else None)
+        self.hist = (np.zeros((num_groups, lh.width), dtype=np.float32)
+                     if self.need_hist else None)
+
+    def add(self, cols, n_valid):
+        import ctypes
+
+        t = cols[self.time_col][:n_valid]
+        if not t.flags.c_contiguous:
+            t = np.ascontiguousarray(t)
+        if self.vcol is not None:
+            v = cols[self.vcol][:n_valid]
+            if v.dtype != np.float64 or not v.flags.c_contiguous:
+                v = np.ascontiguousarray(v, dtype=np.float64)
         else:
-            out[name] = out[name] + hist
-    return out
+            v = np.zeros(1)
+        lib = _native()
+        P = ctypes.POINTER
+        lib.px_window_agg(
+            ctypes.c_int64(len(t)),
+            t.ctypes.data_as(P(ctypes.c_int64)),
+            ctypes.c_int64(self.k.width), ctypes.c_int64(self.t0),
+            ctypes.c_int64(self.num_groups),
+            v.ctypes.data_as(P(ctypes.c_double)),
+            ctypes.c_int64(self.lh.width),
+            ctypes.c_float(1.0 / math.log(self.lh.gamma)),
+            ctypes.c_float(self.lh.min_value),
+            self.counts.ctypes.data_as(P(ctypes.c_int64)),
+            self.sums.ctypes.data_as(P(ctypes.c_double))
+            if self.sums is not None else None,
+            self.hist.ctypes.data_as(P(ctypes.c_float))
+            if self.hist is not None else None,
+        )
+
+    def merge_into(self, state):
+        out = dict(state)
+        for name, uda, _dt in self.init_specs:
+            if isinstance(uda, CountUDA):
+                out[name] = out[name] + self.counts
+            elif isinstance(uda, MeanUDA):
+                out[name] = {"sum": out[name]["sum"] + self.sums,
+                             "count": out[name]["count"] + self.counts}
+            else:
+                out[name] = out[name] + self.hist
+        return out
 
 
 def run(executor, src, names, cap, kern, keys, init_specs, num_groups,
@@ -365,13 +410,18 @@ def run(executor, src, names, cap, kern, keys, init_specs, num_groups,
     fused = _window_fused_ok(kern, keys, init_specs, value_args, t_lo, t_hi)
     if fused:
         t0 = int(np.asarray(luts[keys[0].lut_name])[0])
+        acc = _FusedWindowAcc(lh, keys[0], t0, kern.time_col, init_specs,
+                              value_args, num_groups)
+        # straight off the STORAGE batches — no coalescing/padding copies
+        for rb, _row_id, _gen in src:
+            n = rb.num_valid
+            if n:
+                acc.add(rb.columns, n)
+                executor.stats["rows_scanned"] += n
+                executor.stats["batches"] += 1
+        return acc.merge_into(state)
     for cols, n_valid in executor._feed(src, names, cap, backend="cpu"):
         cols = {k: np.asarray(v) for k, v in cols.items()}
-        if fused:
-            state = _window_fused_feed(lh, cols, n_valid, keys[0], t0,
-                                       kern.time_col, init_specs,
-                                       value_args, num_groups, state)
-            continue
         gid, mask, prefix = _gid_and_mask(
             cols, n_valid, keys, kern, t_lo, t_hi, luts)
         vals_by_name = {

@@ -16,6 +16,7 @@ from typing import Optional
 
 # ---- DWARF constants (DWARF4/5 spec) ----
 DW_TAG_formal_parameter = 0x05
+DW_TAG_unspecified_parameters = 0x18
 DW_TAG_compile_unit = 0x11
 DW_TAG_base_type = 0x24
 DW_TAG_pointer_type = 0x0F
@@ -272,8 +273,10 @@ class DwarfReader:
                         self.functions.setdefault(name, die_off)
                 if children:
                     stack.append(die_off)
-                # record parentage for parameter attachment
-                if stack and tag == DW_TAG_formal_parameter:
+                # record parentage for parameter attachment (and varargs
+                # markers: DW_TAG_unspecified_parameters flags variadics)
+                if stack and tag in (DW_TAG_formal_parameter,
+                                     DW_TAG_unspecified_parameters):
                     attrs["__parent"] = stack[-1]
             pos = next_cu
 
@@ -326,6 +329,18 @@ class DwarfReader:
                 type_name=tname,
             ))
         return out
+
+    def function_is_variadic(self, fn_name: str) -> bool:
+        """True when the subprogram declares `...` varargs
+        (DW_TAG_unspecified_parameters child)."""
+        die_off = self.functions.get(fn_name)
+        if die_off is None:
+            raise KeyError(f"no DWARF subprogram named {fn_name!r}")
+        for off, (tag, attrs) in self.dies.items():
+            if (tag == DW_TAG_unspecified_parameters
+                    and attrs.get("__parent") == die_off):
+                return True
+        return False
 
     def function_names(self) -> list[str]:
         return sorted(self.functions)
