@@ -108,7 +108,11 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
                     f"(DWARF)")
             if n >= 6:
                 a = args[n]
-                if a.location and a.location.startswith("fbreg"):
+                # only CFA-anchored frames make fbreg offsets SP+8-relative
+                # at the entry instruction; clang -O0 anchors on RBP, where
+                # the same read would hit the wrong slot — refuse loudly
+                if (a.location and a.location.startswith("fbreg")
+                        and dwarf_args.get("frame_base") == "cfa"):
                     off = int(a.location[5:])
                     size = a.byte_size or 8
                     return [
@@ -116,8 +120,9 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
                         f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
                     ]
                 raise CompilerError(
-                    f"pxtrace codegen: arg{n} is stack-passed but has no "
-                    f"frame-base DWARF location")
+                    f"pxtrace codegen: arg{n} is stack-passed and the "
+                    f"target's DWARF frame base is not CFA-anchored — "
+                    f"cannot compute its entry-time address")
             size = args[n].byte_size or 8
             cast = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
                     8: "uint64_t"}.get(size, "uint64_t")
@@ -300,8 +305,9 @@ def generate_bcc(name: str, table_name: str, program: str,
             ctx_info["stash_var"] = stash_var
             if dw is not None:
                 try:
-                    ctx_info["variadic"] = dwarf_cache[
-                        binpath].function_is_variadic(sym)
+                    rd = dwarf_cache[binpath]
+                    ctx_info["variadic"] = rd.function_is_variadic(sym)
+                    ctx_info["frame_base"] = rd.function_frame_base(sym)
                 except Exception:
                     ctx_info["variadic"] = False
             lines.append(f"  struct {struct_name} ev = {{}};")

@@ -1850,7 +1850,8 @@ class PlanExecutor:
                 from pixie_tpu.engine import np_partial
 
                 if (self._backend_for(src) == "cpu" and spmd_step is None
-                        and np_partial.eligible(kern, keys, udas, val_dicts)
+                        and np_partial.eligible(kern, keys, udas, val_dicts,
+                                                t_lo, t_hi, src)
                         and np_partial.value_args_ok(kern, op, names)):
                     # CPU streaming/poll fast path: bincount-shaped numpy +
                     # native histogram scatter at memory speed, identical

@@ -54,7 +54,8 @@ def source_col(kern, name: str):
     return prov.name if isinstance(prov, Column) else None
 
 
-def eligible(kern, keys, udas, val_dicts) -> bool:
+def eligible(kern, keys, udas, val_dicts, t_lo=None, t_hi=None,
+             src=None) -> bool:
     """True if this agg can run through the numpy partial loop.  Maps are
     fine as long as every column the loop READS is a pass-through of a
     source column (window binning is already planner-resolved into the
@@ -63,14 +64,23 @@ def eligible(kern, keys, udas, val_dicts) -> bool:
     involved (this loop's edge is the scatter-free bincount shapes)."""
     if kern.steps or kern.has_limit or val_dicts:
         return False
+    if src is not None and not hasattr(src, "__iter__"):
+        return False  # blocking-op HostBatch intermediates use _feed
     if kern.time_col is not None and source_col(
             kern, kern.time_col) != kern.time_col:
         # A map REWROTE the time column.  The kernel's WINDOW key builds on
         # the post-map sval, this loop bins the raw source — only the
         # planner's own `time_ = px.bin(time_, w)` rewrite is bin-
-        # equivalent to raw ((t//w*w)//w == t//w); anything else diverges.
+        # equivalent to raw ((t//w*w)//w == t//w), and even then only the
+        # BIN INDEX: a bounded time mask compares the post-map (binned)
+        # value in the kernel vs raw time here, which diverges at window
+        # edges — so the rewrite is admitted only with unbounded time.
         wkey = next((k for k in keys if k.kind == "window"), None)
         if wkey is None or not _is_bin_of_raw_time(kern, wkey):
+            return False
+        unbounded = (t_lo is not None and t_hi is not None
+                     and t_lo <= -(1 << 62) and t_hi >= (1 << 62))
+        if not unbounded:
             return False
     for k in keys:
         if k.kind not in ("dict", "intdevice", "window"):

@@ -34,6 +34,9 @@ DW_AT_specification = 0x47
 DW_AT_abstract_origin = 0x31
 DW_AT_linkage_name = 0x6E
 DW_AT_str_offsets_base = 0x72
+DW_AT_frame_base = 0x40
+
+DW_OP_call_frame_cfa = 0x9C
 
 DW_OP_fbreg = 0x91
 DW_OP_regn = 0x50  # DW_OP_reg0..reg31 = 0x50..0x6f
@@ -109,6 +112,8 @@ class DwarfReader:
         self.dies: dict[int, tuple[int, dict]] = {}
         #: function name -> subprogram DIE offset
         self.functions: dict[str, int] = {}
+        #: subprogram DIE offsets that declare `...` varargs
+        self._variadic_parents: set[int] = set()
         self._parse()
 
     # ------------------------------------------------------------- abbrevs
@@ -278,6 +283,8 @@ class DwarfReader:
                 if stack and tag in (DW_TAG_formal_parameter,
                                      DW_TAG_unspecified_parameters):
                     attrs["__parent"] = stack[-1]
+                    if tag == DW_TAG_unspecified_parameters:
+                        self._variadic_parents.add(stack[-1])
             pos = next_cu
 
     # ----------------------------------------------------------------- query
@@ -332,15 +339,29 @@ class DwarfReader:
 
     def function_is_variadic(self, fn_name: str) -> bool:
         """True when the subprogram declares `...` varargs
-        (DW_TAG_unspecified_parameters child)."""
+        (DW_TAG_unspecified_parameters child) — O(1), recorded at parse."""
         die_off = self.functions.get(fn_name)
         if die_off is None:
             raise KeyError(f"no DWARF subprogram named {fn_name!r}")
-        for off, (tag, attrs) in self.dies.items():
-            if (tag == DW_TAG_unspecified_parameters
-                    and attrs.get("__parent") == die_off):
-                return True
-        return False
+        return die_off in self._variadic_parents
+
+    def function_frame_base(self, fn_name: str):
+        """'cfa' | 'reg<N>' | None — how fbreg offsets are anchored
+        (DW_AT_frame_base).  gcc emits DW_OP_call_frame_cfa; clang -O0
+        anchors on RBP (reg6), which shifts every fbreg offset — codegen
+        must not assume CFA blindly."""
+        die_off = self.functions.get(fn_name)
+        if die_off is None:
+            raise KeyError(f"no DWARF subprogram named {fn_name!r}")
+        _tag, attrs = self.dies[die_off]
+        expr = attrs.get(DW_AT_frame_base)
+        if not isinstance(expr, (bytes, bytearray)) or not expr:
+            return None
+        if expr[0] == DW_OP_call_frame_cfa:
+            return "cfa"
+        if DW_OP_regn <= expr[0] <= DW_OP_regn + 31:
+            return f"reg{expr[0] - DW_OP_regn}"
+        return None
 
     def function_names(self) -> list[str]:
         return sorted(self.functions)

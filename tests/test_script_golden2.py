@@ -935,3 +935,81 @@ class TestIpScript:
         assert_frames(res, out,
                       approx=("bytes_per_s_from_ip", "bytes_per_s_to_ip",
                               "total_bytes_per_s"), rtol=1e-9)
+
+
+class TestKafkaWithRealBodies:
+    """Non-degenerate kafka oracle: crafted JoinGroup/SyncGroup JSON bodies
+    flow through the whole rebalancing pipeline (api-key naming, pluck,
+    join/sync pairing, max-generation merge) and must reproduce the
+    membership counts the oracle computes directly."""
+
+    def _kafka_store(self):
+        import json as _json
+
+        from pixie_tpu.collect.schemas import SCHEMAS
+        from pixie_tpu.table import TableStore
+
+        snap = _snap()
+        upids = sorted(snap.upid_to_pod_uid)
+        ts = TableStore()
+        t = ts.create("kafka_events.beta", SCHEMAS["kafka_events.beta"],
+                      batch_rows=512)
+        rows = {k: [] for k in ("time_", "upid", "remote_addr",
+                                "remote_port", "trace_role", "req_cmd",
+                                "client_id", "req_body", "resp", "latency")}
+        t0 = NOW - 200 * SEC
+        i = 0
+        # 2 consumer groups x generations {1,2} x members; generation 2 is
+        # the live one per group
+        plan = {"cg-a": {1: ["m0", "m1"], 2: ["m0", "m1", "m2"]},
+                "cg-b": {1: ["x0"], 2: ["x0", "x1"]}}
+        for gid_name, gens in plan.items():
+            for gen, members in gens.items():
+                for m in members:
+                    tj = t0 + i * SEC
+                    # JoinGroup: ids arrive in the RESPONSE
+                    rows["time_"].append(tj)
+                    rows["req_cmd"].append(11)
+                    rows["req_body"].append(_json.dumps(
+                        {"group_id": gid_name}))
+                    rows["resp"].append(_json.dumps(
+                        {"generation_id": gen, "member_id": m}))
+                    # SyncGroup 50ms later: ids in the REQUEST
+                    rows["time_"].append(tj + 50_000_000)
+                    rows["req_cmd"].append(14)
+                    rows["req_body"].append(_json.dumps(
+                        {"group_id": gid_name, "generation_id": gen,
+                         "member_id": m}))
+                    rows["resp"].append(_json.dumps({"error_code": 0}))
+                    for _ in range(2):
+                        rows["upid"].append(upids[i % len(upids)])
+                        rows["remote_addr"].append("10.0.0.1")
+                        rows["remote_port"].append(9092)
+                        rows["trace_role"].append(1)
+                        rows["client_id"].append("consumer")
+                        rows["latency"].append(1_000_000)
+                    i += 1
+        t.write({k: (np.asarray(v) if k in ("time_", "req_cmd",
+                                            "remote_port", "trace_role",
+                                            "latency")
+                     else v) for k, v in rows.items()})
+        return ts
+
+    def test_kafka_group_ids_counts_live_generation(self):
+        import tests.test_all_scripts as harness
+        from pixie_tpu.collect.schemas import all_schemas
+        from pixie_tpu.compiler import compile_pxl
+        from pixie_tpu.engine import execute_plan
+
+        ts = self._kafka_store()
+        d = SCRIPTS / "kafka_consumer_rebalancing"
+        source = harness._source_of(d)
+        q = compile_pxl(source, all_schemas(), func="kafka_group_ids",
+                        func_args={"start_time": "-5m"}, now=NOW)
+        res = execute_plan(q.plan, ts)["output"]
+        got = res.to_pandas().sort_values("group_id").reset_index(drop=True)
+        # oracle: live generation per group -> member count
+        exp = pd.DataFrame({"group_id": ["cg-a", "cg-b"],
+                            "num_members": [3, 2]})
+        assert got["group_id"].tolist() == exp["group_id"].tolist()
+        assert got["num_members"].tolist() == exp["num_members"].tolist()
