@@ -275,19 +275,26 @@ def cmd_broker(args) -> int:
     return 0
 
 
-def cmd_ui(args) -> int:
-    """Serve the Live View (reference src/ui Live View, server-rendered)."""
-    from pixie_tpu.webui import LiveServer, broker_runner, local_runner
+def _make_runner(args):
+    """Shared execution backend for the live surfaces (`ui`, `live`):
+    a broker client when --broker is given, else in-process demo data."""
+    from pixie_tpu.webui import broker_runner, local_runner
 
     if args.broker:
         from pixie_tpu.services.client import Client
 
         host, port = args.broker.rsplit(":", 1)
-        runner = broker_runner(Client(host, int(port),
-                                      auth_token=args.auth_token))
-    else:
-        store, now = _demo_cluster()
-        runner = local_runner(store, now=now)
+        return broker_runner(Client(host, int(port),
+                                    auth_token=args.auth_token))
+    store, now = _demo_cluster()
+    return local_runner(store, now=now)
+
+
+def cmd_ui(args) -> int:
+    """Serve the Live View (reference src/ui Live View, server-rendered)."""
+    from pixie_tpu.webui import LiveServer
+
+    runner = _make_runner(args)
     server = LiveServer(runner, scripts_dir=args.bundle,
                         host=args.host, port=args.port).start()
     print(f"live view on http://{args.host}:{server.port}/", flush=True)
@@ -297,6 +304,13 @@ def cmd_ui(args) -> int:
     except KeyboardInterrupt:
         server.stop()
     return 0
+
+
+def cmd_live(args) -> int:
+    """Interactive live REPL (reference src/pixie_cli/pkg/live/)."""
+    from pixie_tpu.cli_live import main_live
+
+    return main_live(_make_runner(args), args.bundle)
 
 
 def cmd_agent(args) -> int:
@@ -355,6 +369,12 @@ def main(argv=None) -> int:
     ui.add_argument("--broker", help="host:port (default: in-process demo data)")
     ui.add_argument("--auth-token", default=None)
     ui.set_defaults(fn=cmd_ui)
+
+    lv = sub.add_parser("live", help="interactive live REPL with completion")
+    lv.add_argument("--bundle", default=str(DEFAULT_SCRIPTS))
+    lv.add_argument("--broker", help="host:port (default: in-process demo data)")
+    lv.add_argument("--auth-token", default=None)
+    lv.set_defaults(fn=cmd_live)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)

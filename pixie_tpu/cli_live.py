@@ -1,0 +1,233 @@
+"""Interactive live CLI: the `px live` REPL.
+
+Reference: src/pixie_cli/pkg/live/ — an autocomplete TUI that lets the user
+pick bundled scripts, edit arguments, and re-run in place.  This build is a
+readline REPL over the same engine surfaces the one-shot CLI uses:
+tab-completion over script names, vis variables and commands; `run`
+re-executes with the current variables; `watch` re-renders on an interval
+(the live loop).  The session logic lives in `LiveSession` (pure
+line-in/text-out, so tests drive it without a TTY); `main_live` wires
+readline + the prompt loop around it.
+"""
+from __future__ import annotations
+
+import pathlib
+import time
+from typing import Callable, Optional
+
+HELP = """\
+commands:
+  scripts [filter]      list bundled scripts
+  use <script>          select a script (tab-completes)
+  args                  show the selected script's variables
+  set <name>=<value>    set a variable (tab-completes names)
+  run [script]          execute and render widgets
+  watch [seconds]       re-run every N seconds (ctrl-c stops)
+  help                  this text
+  quit                  exit
+"""
+
+
+class LiveSession:
+    """State + command handling for the live loop (testable core)."""
+
+    def __init__(self, runner: Callable, scripts_dir,
+                 render: Optional[Callable] = None, max_rows: int = 15):
+        """runner(source, funcs) -> (results, sink_map) — the webui runner
+        contract (webui.local_runner / broker_runner)."""
+        self.runner = runner
+        self.scripts_dir = pathlib.Path(scripts_dir)
+        self.max_rows = max_rows
+        self.script: Optional[str] = None
+        self.vars: dict[str, str] = {}
+        self._render = render or self._default_render
+
+    # ------------------------------------------------------------- catalog
+    def script_names(self) -> list[str]:
+        return sorted(
+            d.name for d in self.scripts_dir.iterdir()
+            if d.is_dir() and list(d.glob("*.pxl"))
+        )
+
+    def _load(self, name: str):
+        import json
+
+        from pixie_tpu.vis import parse_vis
+
+        d = self.scripts_dir / name
+        pxls = sorted(d.glob("*.pxl"))
+        if not pxls:
+            raise FileNotFoundError(name)
+        vis_path = d / "vis.json"
+        vis = parse_vis(json.loads(vis_path.read_text())) \
+            if vis_path.exists() else parse_vis({})
+        return pxls[0].read_text(), vis
+
+    # ---------------------------------------------------------- completion
+    def complete(self, text: str, line: str) -> list[str]:
+        """Candidates for the token `text` given the whole `line` — the
+        autocomplete brain (reference live view's script/arg suggester)."""
+        words = line.split()
+        first = words[0] if words else ""
+        completing_first = len(words) <= 1 and not line.endswith(" ")
+        if completing_first:
+            cmds = ["scripts", "use", "args", "set", "run", "watch",
+                    "help", "quit"]
+            return [c for c in cmds if c.startswith(text)]
+        if first in ("use", "run", "scripts"):
+            return [s for s in self.script_names() if s.startswith(text)]
+        if first == "set" and self.script:
+            _src, vis = self._load(self.script)
+            names = [v.name for v in vis.variables]
+            return [f"{n}=" for n in names if n.startswith(text)]
+        return []
+
+    # ------------------------------------------------------------ commands
+    def handle_line(self, line: str) -> str:
+        line = line.strip()
+        if not line:
+            return ""
+        cmd, _, rest = line.partition(" ")
+        rest = rest.strip()
+        if cmd in ("quit", "exit"):
+            raise SystemExit(0)
+        if cmd == "help":
+            return HELP
+        if cmd == "scripts":
+            names = self.script_names()
+            if rest:
+                names = [n for n in names if rest in n]
+            return "\n".join(names)
+        if cmd == "use":
+            if rest not in self.script_names():
+                return f"unknown script {rest!r} (try: scripts)"
+            self.script = rest
+            self.vars = {}
+            return self._args_text()
+        if cmd == "args":
+            if not self.script:
+                return "no script selected (use <script>)"
+            return self._args_text()
+        if cmd == "set":
+            if "=" not in rest:
+                return "usage: set name=value"
+            k, _, v = rest.partition("=")
+            self.vars[k.strip()] = v.strip()
+            return f"{k.strip()} = {v.strip()}"
+        if cmd == "run":
+            if rest:
+                if rest not in self.script_names():
+                    return f"unknown script {rest!r}"
+                self.script = rest
+            if not self.script:
+                return "no script selected (use <script>)"
+            return self.execute()
+        if cmd == "watch":
+            return "__watch__"  # the REPL loop interprets this
+        return f"unknown command {cmd!r} (help for commands)"
+
+    def _args_text(self) -> str:
+        _src, vis = self._load(self.script)
+        values = vis.variable_values(self.vars)
+        lines = [f"script: {self.script}"]
+        for v in vis.variables:
+            cur = values.get(v.name, "")
+            lines.append(f"  {v.name} = {cur!r}")
+        return "\n".join(lines)
+
+    def execute(self) -> str:
+        source, vis = self._load(self.script)
+        runs = vis.executions(self.vars)
+        t0 = time.perf_counter()
+        chunks = []
+        if runs:
+            results, sink_map = self.runner(source, list(runs))
+            displays = vis.widget_displays()
+            for out_name, _fn, _args in runs:
+                w = displays.get(out_name)
+                for _orig, fused in sink_map.get(out_name, {}).items():
+                    res = results.get(fused)
+                    if res is None:
+                        continue
+                    chunks.append(self._render(
+                        out_name, w.kind if w else "Table",
+                        w.display if w else {}, res))
+        else:
+            results, _ = self.runner(source, None)
+            for sink, res in results.items():
+                chunks.append(self._render(sink, "Table", {}, res))
+        dt = (time.perf_counter() - t0) * 1000
+        chunks.append(f"({dt:.0f} ms)")
+        return "\n\n".join(chunks)
+
+    def _default_render(self, name, kind, display, res) -> str:
+        from pixie_tpu.cli import render_table
+        from pixie_tpu.cli_widgets import render_widget
+
+        hdr = f"== {name} [{kind}] ({res.num_rows} rows)"
+        chart = render_widget(kind, display, res)
+        body = chart if chart else render_table(res, max_rows=self.max_rows)
+        return f"{hdr}\n{body}"
+
+
+def main_live(runner: Callable, scripts_dir, poll_s: float = 2.0) -> int:
+    """The readline prompt loop around a LiveSession."""
+    import readline
+
+    session = LiveSession(runner, scripts_dir)
+
+    cand_cache: list = []
+
+    def completer(text, state):
+        try:
+            if state == 0:
+                # compute ONCE per tab press; readline calls back with
+                # increasing `state` to walk the same candidate list
+                cand_cache[:] = session.complete(
+                    text, readline.get_line_buffer())
+            return cand_cache[state] if state < len(cand_cache) else None
+        except Exception:
+            return None
+
+    readline.set_completer(completer)
+    readline.set_completer_delims(" \t")
+    readline.parse_and_bind("tab: complete")
+    print("px live — tab completes; `help` for commands")
+    while True:
+        try:
+            line = input("px> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return 0
+        try:
+            out = session.handle_line(line)
+        except SystemExit:
+            return 0
+        except Exception as e:  # surface errors, keep the loop alive
+            print(f"error: {type(e).__name__}: {e}")
+            continue
+        if out == "__watch__":
+            parts = line.split()
+            try:
+                interval = float(parts[1]) if len(parts) > 1 else poll_s
+            except ValueError:
+                print(f"usage: watch [seconds], got {parts[1]!r}")
+                continue
+            if not session.script:
+                print("no script selected (use <script>)")
+                continue
+            try:
+                while True:
+                    print("\033[2J\033[H", end="")  # clear screen
+                    print(f"[watch {session.script} every {interval}s — "
+                          f"ctrl-c stops]")
+                    print(session.execute())
+                    time.sleep(interval)
+            except KeyboardInterrupt:
+                print()
+                continue
+            except Exception as e:  # keep the REPL alive like every command
+                print(f"error: {type(e).__name__}: {e}")
+                continue
+        elif out:
+            print(out)

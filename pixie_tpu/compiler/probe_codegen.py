@@ -108,21 +108,24 @@ def _expr_for(field: str, expr: str, probe: LogicalProbe,
                     f"(DWARF)")
             if n >= 6:
                 a = args[n]
+                if not (a.location and a.location.startswith("fbreg")):
+                    raise CompilerError(
+                        f"pxtrace codegen: arg{n} is stack-passed but has "
+                        f"no frame-base DWARF location")
                 # only CFA-anchored frames make fbreg offsets SP+8-relative
                 # at the entry instruction; clang -O0 anchors on RBP, where
                 # the same read would hit the wrong slot — refuse loudly
-                if (a.location and a.location.startswith("fbreg")
-                        and dwarf_args.get("frame_base") == "cfa"):
-                    off = int(a.location[5:])
-                    size = a.byte_size or 8
-                    return [
-                        f"  bpf_probe_read(&ev.{field}, {size}, "
-                        f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
-                    ]
-                raise CompilerError(
-                    f"pxtrace codegen: arg{n} is stack-passed and the "
-                    f"target's DWARF frame base is not CFA-anchored — "
-                    f"cannot compute its entry-time address")
+                if dwarf_args.get("frame_base") != "cfa":
+                    raise CompilerError(
+                        f"pxtrace codegen: arg{n} is stack-passed and the "
+                        f"target's DWARF frame base is not CFA-anchored — "
+                        f"cannot compute its entry-time address")
+                off = int(a.location[5:])
+                size = a.byte_size or 8
+                return [
+                    f"  bpf_probe_read(&ev.{field}, {size}, "
+                    f"(void*)(PT_REGS_SP(ctx) + 8 + ({off})));",
+                ]
             size = args[n].byte_size or 8
             cast = {1: "uint8_t", 2: "uint16_t", 4: "uint32_t",
                     8: "uint64_t"}.get(size, "uint64_t")

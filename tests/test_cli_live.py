@@ -1,0 +1,72 @@
+"""Interactive live CLI (cli_live.LiveSession) — the reference's
+src/pixie_cli/pkg/live/ autocomplete TUI loop, driven headlessly."""
+import time
+
+import pytest
+
+from pixie_tpu.cli_live import LiveSession
+from pixie_tpu.webui import DEFAULT_SCRIPTS, local_runner
+
+
+@pytest.fixture(scope="module")
+def session():
+    from pixie_tpu.metadata.state import set_global_manager
+    from pixie_tpu.testing import build_demo_store, demo_metadata
+
+    mgr, _, _ = demo_metadata()
+    set_global_manager(mgr)
+    now = time.time_ns()
+    store = build_demo_store(rows=2000, now_ns=now, span_s=300)
+    return LiveSession(local_runner(store, now=now), DEFAULT_SCRIPTS)
+
+
+class TestCompletion:
+    def test_command_completion(self, session):
+        assert session.complete("s", "s") == ["scripts", "set"]
+        assert session.complete("wa", "wa") == ["watch"]
+
+    def test_script_completion_after_use(self, session):
+        got = session.complete("http_", "use http_")
+        assert "http_data" in got and "http_data_filtered" in got
+
+    def test_variable_completion_after_set(self, session):
+        session.handle_line("use http_data")
+        got = session.complete("start", "set start")
+        assert got == ["start_time="]
+
+
+class TestCommands:
+    def test_scripts_filter(self, session):
+        out = session.handle_line("scripts kafka")
+        assert "kafka_data" in out and "http_data" not in out
+
+    def test_use_shows_args(self, session):
+        out = session.handle_line("use http_data")
+        assert "start_time" in out and "'-5m'" in out
+
+    def test_set_and_args_roundtrip(self, session):
+        session.handle_line("use http_data")
+        assert session.handle_line("set start_time=-2m") == \
+            "start_time = -2m"
+        assert "'-2m'" in session.handle_line("args")
+
+    def test_unknown_script_is_friendly(self, session):
+        out = session.handle_line("use nope_nope")
+        assert "unknown script" in out
+
+    def test_run_renders_widgets(self, session):
+        session.handle_line("use http_data")
+        out = session.handle_line("run")
+        assert "== http_data" in out
+        assert "rows)" in out and "ms)" in out
+
+    def test_run_with_inline_script(self, session):
+        out = session.handle_line("run cluster")
+        assert "== " in out and "ms)" in out
+
+    def test_watch_is_signalled_to_loop(self, session):
+        assert session.handle_line("watch 1") == "__watch__"
+
+    def test_quit_raises_systemexit(self, session):
+        with pytest.raises(SystemExit):
+            session.handle_line("quit")

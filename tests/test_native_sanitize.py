@@ -34,9 +34,12 @@ def san_bin(tmp_path_factory):
 
 
 def test_native_hot_loops_clean_under_asan_ubsan(san_bin):
+    import os
+
     r = subprocess.run(
         [san_bin], capture_output=True, text=True, timeout=300,
-        env={"ASAN_OPTIONS": "detect_leaks=1:abort_on_error=0",
+        env={**os.environ,
+             "ASAN_OPTIONS": "detect_leaks=1:abort_on_error=0",
              "UBSAN_OPTIONS": "print_stacktrace=1"})
     assert r.returncode == 0, f"sanitizer failure:\n{r.stderr[-4000:]}"
     assert "all checks passed" in r.stdout
