@@ -1013,3 +1013,101 @@ class TestKafkaWithRealBodies:
                             "num_members": [3, 2]})
         assert got["group_id"].tolist() == exp["group_id"].tolist()
         assert got["num_members"].tolist() == exp["num_members"].tolist()
+
+
+class TestSecondFuncs:
+    """Deeper golden coverage: a SECOND vis func for the heavy multi-func
+    scripts (services timeseries LET, pod per-container resources)."""
+
+    def test_services_inbound_service_let(self):
+        res = one_result(run_func(
+            "services", "inbound_service_let",
+            {"start_time": "-5m", "namespace": "default"}))
+        df = since(tdf("http_events"), 300).copy()
+        df["service"] = df["upid"].map(q_svc)
+        df["pod"] = df["upid"].map(q_pod)
+        df["ns"] = df["upid"].map(q_ns)
+        df = df[(df["ns"] == "default") & (df["pod"] != "")]
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        df["failure"] = df["resp_status"] >= 400
+        df = df[(df["req_path"] != "/healthz") & (df["req_path"] != "/readyz")
+                & (df["remote_addr"] != "-")]
+        df = df[df["trace_role"] == 2]
+        groups = ["timestamp", "service"]
+        q = df.groupby(groups, as_index=False).agg(
+            error_rate=("failure", "mean"),
+            throughput_total=("latency", "count"),
+            inbound_bytes_total=("req_body_size", "sum"),
+            outbound_bytes_total=("resp_body_size", "sum"))
+        lat = df.groupby(groups)["latency"]
+        q["latency_p50"] = np.floor(_q(lat, 0.5).to_numpy())
+        q["latency_p90"] = np.floor(_q(lat, 0.9).to_numpy())
+        q["latency_p99"] = np.floor(_q(lat, 0.99).to_numpy())
+        q["request_throughput"] = q["throughput_total"] / WINDOW
+        q["inbound_throughput"] = q["inbound_bytes_total"] / WINDOW
+        q["outbound_throughput"] = q["outbound_bytes_total"] / WINDOW
+        q["time_"] = q["timestamp"]
+        exp = q[["time_", "service", "latency_p50", "latency_p90",
+                 "latency_p99", "request_throughput", "error_rate",
+                 "inbound_throughput", "outbound_throughput"]]
+        assert_frames(
+            res, exp,
+            approx=APPROX_Q + APPROX_RATES + ("inbound_throughput",
+                                              "outbound_throughput"),
+            rtol=0.05)
+
+    def test_pod_resource_timeseries(self):
+        res = one_result(run_func(
+            "pod", "resource_timeseries",
+            {"start_time": "-5m", "pod": "default/frontend-0"}))
+        snap = _snap()
+        df = since(tdf("process_stats"), 300).copy()
+        df["pod"] = df["upid"].map(q_pod)
+        df = df[df["pod"] == "default/frontend-0"]
+        df["container"] = df["upid"].map(
+            lambda u: snap.containers_by_id[
+                snap.upid_to_container_id[u]].name)
+        df["timestamp"] = (df["time_"] // WINDOW) * WINDOW
+        per = (df.groupby(["upid", "container", "timestamp"], as_index=False)
+               .agg(rss=("rss_bytes", "mean"), vsize=("vsize_bytes", "mean"),
+                    cu_max=("cpu_utime_ns", "max"),
+                    cu_min=("cpu_utime_ns", "min"),
+                    ck_max=("cpu_ktime_ns", "max"),
+                    ck_min=("cpu_ktime_ns", "min"),
+                    rb_max=("read_bytes", "max"),
+                    rb_min=("read_bytes", "min"),
+                    wb_max=("write_bytes", "max"),
+                    wb_min=("write_bytes", "min"),
+                    rc_max=("rchar_bytes", "max"),
+                    rc_min=("rchar_bytes", "min"),
+                    wc_max=("wchar_bytes", "max"),
+                    wc_min=("wchar_bytes", "min")))
+        per["cu"] = per["cu_max"] - per["cu_min"]
+        per["ck"] = per["ck_max"] - per["ck_min"]
+        per["adrt"] = (per["rb_max"] - per["rb_min"]) / WINDOW
+        per["adwt"] = (per["wb_max"] - per["wb_min"]) / WINDOW
+        per["tdrt"] = (per["rc_max"] - per["rc_min"]) / WINDOW
+        per["tdwt"] = (per["wc_max"] - per["wc_min"]) / WINDOW
+        out = (per.groupby(["timestamp", "container"], as_index=False)
+               .agg(actual_disk_read_throughput=("adrt", "sum"),
+                    actual_disk_write_throughput=("adwt", "sum"),
+                    total_disk_read_throughput=("tdrt", "sum"),
+                    total_disk_write_throughput=("tdwt", "sum"),
+                    rss=("rss", "sum"), vsize=("vsize", "sum"),
+                    cu=("cu", "sum"), ck=("ck", "sum")))
+        out["cpu_usage"] = (out["ck"] + out["cu"]) / WINDOW
+        out["time_"] = out["timestamp"]
+        exp = out.drop(columns=["timestamp", "cu", "ck"])
+        exp = exp[["container", "actual_disk_read_throughput",
+                   "actual_disk_write_throughput",
+                   "total_disk_read_throughput",
+                   "total_disk_write_throughput", "rss", "vsize",
+                   "cpu_usage", "time_"]]
+        assert_frames(
+            res, exp,
+            approx=("actual_disk_read_throughput",
+                    "actual_disk_write_throughput",
+                    "total_disk_read_throughput",
+                    "total_disk_write_throughput", "rss", "vsize",
+                    "cpu_usage"),
+            rtol=1e-9)
