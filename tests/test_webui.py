@@ -241,6 +241,60 @@ def test_run_api_rejects_cross_origin(server):
     assert "cross-origin" in out["error"]
 
 
+def test_broker_runner_end_to_end():
+    """The OTHER runner path: Live View backed by a real broker+agent
+    cluster (fused multi-widget execution over the wire)."""
+    import numpy as np
+
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+    from pixie_tpu.webui import LiveServer, broker_runner
+
+    rng = np.random.default_rng(5)
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS),
+                      ("service", DT.STRING), ("latency", DT.FLOAT64),
+                      ("status", DT.INT64))
+    t = ts.create("http_events", rel, batch_rows=512)
+    n = 1500
+    t.write({
+        "time_": np.arange(n, dtype=np.int64) * 1000,
+        "service": rng.choice(["cart", "web"], n).tolist(),
+        "latency": rng.exponential(20.0, n),
+        "status": rng.choice([200, 500], n),
+    })
+    broker = Broker(hb_expiry_s=2.0, query_timeout_s=30.0).start()
+    agent = Agent("pem1", "127.0.0.1", broker.port, store=ts,
+                  heartbeat_s=0.2).start()
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    srv = LiveServer(broker_runner(client)).start()
+    try:
+        code, out = _post(
+            srv, "/api/run",
+            {"script": "http_data",
+             "source": ("import px\n"
+                        "df = px.DataFrame(table='http_events')\n"
+                        "def http_data(start_time: str, source_filter: str,"
+                        " destination_filter: str, num_head: int):\n"
+                        "    d = px.DataFrame(table='http_events')\n"
+                        "    return d.groupby('service').agg("
+                        "n=('latency', px.count))\n")},
+            token=srv.session_token)
+        assert code == 200, out
+        assert "error" not in out, out
+        assert out["widgets"], "broker-backed run must render widgets"
+        html = out["widgets"][0]["html"]
+        assert "cart" in html and "web" in html
+    finally:
+        srv.stop()
+        client.close()
+        agent.stop()
+        broker.stop()
+
+
 def test_run_api_surfaces_script_error_as_json(server):
     code, out = _post(server, "/api/run",
                       {"script": "http_data", "source": "import px\nboom("},
