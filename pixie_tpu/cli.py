@@ -242,10 +242,12 @@ def cmd_explain(args) -> int:
 
 
 def cmd_scripts(args) -> int:
-    bundle = pathlib.Path(args.bundle)
-    for d in sorted(bundle.iterdir()):
-        if not d.is_dir() or not list(d.glob("*.pxl")):
-            continue
+    # reference ∪ repo-shipped scripts, overlaid by an explicit --bundle —
+    # the same resolution surface the Web UI and live REPL use
+    from pixie_tpu.scripts import bundle_map
+
+    m = bundle_map(args.bundle)
+    for d in (m[k] for k in sorted(m)):
         desc = ""
         manifest = d / "manifest.yaml"
         if manifest.exists():
@@ -344,7 +346,9 @@ def main(argv=None) -> int:
     exp.set_defaults(fn=cmd_explain)
 
     sc = sub.add_parser("scripts", help="list bundled scripts")
-    sc.add_argument("--bundle", default="/root/reference/src/pxl_scripts/px")
+    sc.add_argument("--bundle", default=None,
+                    help="script bundle dir (default: reference checkout "
+                         "∪ repo-shipped scripts)")
     sc.set_defaults(fn=cmd_scripts)
 
     br = sub.add_parser("broker", help="start a query broker")

@@ -44,17 +44,19 @@ class LiveSession:
 
     # ------------------------------------------------------------- catalog
     def script_names(self) -> list[str]:
-        return sorted(
-            d.name for d in self.scripts_dir.iterdir()
-            if d.is_dir() and list(d.glob("*.pxl"))
-        )
+        from pixie_tpu.scripts import bundle_map
+
+        return sorted(bundle_map(self.scripts_dir))
 
     def _load(self, name: str):
         import json
 
+        from pixie_tpu.scripts import bundle_map
         from pixie_tpu.vis import parse_vis
 
-        d = self.scripts_dir / name
+        d = bundle_map(self.scripts_dir).get(name)
+        if d is None:
+            raise FileNotFoundError(name)
         pxls = sorted(d.glob("*.pxl"))
         if not pxls:
             raise FileNotFoundError(name)

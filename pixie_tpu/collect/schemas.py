@@ -202,5 +202,13 @@ SCHEMAS: dict[str, Relation] = {
 }
 
 
+def _self_telemetry_schemas() -> dict[str, Relation]:
+    # self-telemetry (pixie_tpu observing itself): trace spans of the query
+    # path, owned by pixie_tpu.trace and written on every agent's store
+    from pixie_tpu.trace import SPANS_RELATION, SPANS_TABLE
+
+    return {SPANS_TABLE: SPANS_RELATION}
+
+
 def all_schemas() -> dict[str, Relation]:
-    return dict(SCHEMAS)
+    return {**SCHEMAS, **_self_telemetry_schemas()}

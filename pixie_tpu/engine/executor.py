@@ -1037,7 +1037,10 @@ class PlanExecutor:
         order, so a plain stack pop could discharge someone else's frame.
         """
         rec = {"ops": ops, "label": label, "wall_ns": 0, "rows_out": 0,
-               "bytes_out": 0, "_child_ns": 0}
+               "bytes_out": 0, "_child_ns": 0,
+               # wall-clock anchor so the frame adapts into a trace span
+               # (self-telemetry) without extra timing calls
+               "t0_unix_ns": _time.time_ns()}
         parent = self._stat_stack[-1] if self._stat_stack else None
         self._stat_stack.append(rec)
         t0 = _time.perf_counter_ns()
@@ -1055,6 +1058,21 @@ class PlanExecutor:
                 parent["_child_ns"] += rec["wall_ns"]
             rec["self_ns"] = rec["wall_ns"] - rec.pop("_child_ns")
             self.op_stats.append(rec)
+
+    def _emit_op_spans(self) -> None:
+        """Adapt the per-op exec stats into trace spans (near-zero cost: the
+        frames already carry wall-clock anchors; under no active trace this
+        is one ContextVar read)."""
+        from pixie_tpu import trace
+
+        if not self.op_stats or trace.current() is None:
+            return
+        for rec in self.op_stats:
+            t0 = rec.get("t0_unix_ns")
+            if t0 is None:
+                continue
+            trace.event_span(rec["label"], t0, rec["wall_ns"],
+                             rows_out=rec.get("rows_out", 0))
 
     def _chain_label(self, head, chain, terminal: str = "") -> str:
         parts = []
@@ -2303,6 +2321,7 @@ class PlanExecutor:
                 out[sink.channel] = self._materialize_parent(parent)
         self.stats["wall_ns"] = _time.perf_counter_ns() - t0
         self.stats["operators"] = self.op_stats
+        self._emit_op_spans()
         return out
 
     def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None,
@@ -2609,6 +2628,7 @@ class PlanExecutor:
             )
         self.stats["wall_ns"] = _time.perf_counter_ns() - t0
         self.stats["operators"] = self.op_stats
+        self._emit_op_spans()
         for r in results.values():
             r.exec_stats["wall_ns"] = self.stats["wall_ns"]
             r.exec_stats["operators"] = self.op_stats

@@ -6,11 +6,20 @@ in ONE overlapped transfer wave.  Rationale: with a remote/tunneled TPU every
 synchronous `np.asarray(jax_array)` pays a fixed round-trip (~160 ms measured);
 issuing `copy_to_host_async` on every leaf first overlaps the round-trips, so N
 pulls cost ~1 RTT instead of N (measured: 10 pulls 1650 ms → 95 ms).
+
+Each wave that actually touches device arrays is self-telemetered: its
+latency lands in the px_readback_wave_seconds histogram and, under an active
+trace, as a `readback_wave` span (see pixie_tpu.trace).
 """
 from __future__ import annotations
 
+import time
+
 import jax
 import numpy as np
+
+#: wave latencies span ~1 ms (local CPU) to seconds (tunneled TPU)
+WAVE_BOUNDS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 def pull(tree):
@@ -19,11 +28,23 @@ def pull(tree):
     Numpy leaves pass through unchanged.
     """
     leaves, treedef = jax.tree.flatten(tree)
+    n_dev = 0
     for leaf in leaves:
         if isinstance(leaf, jax.Array):
             leaf.copy_to_host_async()
+            n_dev += 1
+    if n_dev == 0:
+        return jax.tree.unflatten(treedef, leaves)
+    t0 = time.time_ns()
     out = [
         np.asarray(leaf) if isinstance(leaf, jax.Array) else leaf
         for leaf in leaves
     ]
+    dt_ns = time.time_ns() - t0
+    from pixie_tpu import metrics, trace
+
+    metrics.histogram_observe(
+        "px_readback_wave_seconds", dt_ns / 1e9, WAVE_BOUNDS,
+        help_="device->host readback wave latency (overlapped pull)")
+    trace.event_span("readback_wave", t0, dt_ns, leaves=n_dev)
     return jax.tree.unflatten(treedef, out)

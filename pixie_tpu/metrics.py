@@ -16,6 +16,8 @@ _lock = threading.Lock()
 _counters: dict[tuple, float] = {}
 _gauges: dict[tuple, float] = {}
 _gauge_fns: dict[str, tuple[str, Callable[[], dict]]] = {}
+#: key -> {"bounds": tuple, "counts": per-bucket (non-cumulative), "sum", "count"}
+_hists: dict[tuple, dict] = {}
 _help: dict[str, str] = {}
 
 
@@ -40,11 +42,44 @@ def gauge_set(name: str, value: float, labels: Optional[dict] = None,
             _help.setdefault(name, help_)
 
 
+def histogram_observe(name: str, value: float, bounds: tuple,
+                      labels: Optional[dict] = None, help_: str = "") -> None:
+    """Record one observation into a histogram with DECLARED bucket bounds
+    (Prometheus text `_bucket`/`_sum`/`_count` rendering; bounds are upper
+    bounds, +Inf is implicit).  First observation fixes the bounds for that
+    (name, labels) series; later calls must pass the same bounds."""
+    bounds = tuple(float(b) for b in bounds)
+    with _lock:
+        k = _key(name, labels)
+        h = _hists.get(k)
+        if h is None:
+            if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+                raise ValueError(f"histogram {name}: bounds must increase")
+            h = {"bounds": bounds, "counts": [0] * len(bounds),
+                 "sum": 0.0, "count": 0}
+            _hists[k] = h
+            if help_:
+                _help.setdefault(name, help_)
+        elif h["bounds"] != bounds:
+            raise ValueError(f"histogram {name}: bounds redeclared")
+        h["sum"] += float(value)
+        h["count"] += 1
+        for i, b in enumerate(h["bounds"]):
+            if value <= b:
+                h["counts"][i] += 1
+                break
+
+
 def register_gauge_fn(name: str, fn: Callable[[], dict], help_: str = "") -> None:
     """Lazy gauge: fn() -> {labels-tuple-or-frozen-dict: value} evaluated at
     render time (per-table sizes, registry liveness, ...)."""
     with _lock:
         _gauge_fns[name] = (help_, fn)
+
+
+def has_gauge_fn(name: str) -> bool:
+    with _lock:
+        return name in _gauge_fns
 
 
 def unregister_gauge_fn(name: str) -> None:
@@ -68,6 +103,9 @@ def render() -> str:
         counters = dict(_counters)
         gauges = dict(_gauges)
         gauge_fns = dict(_gauge_fns)
+        hists = {k: {"bounds": h["bounds"], "counts": list(h["counts"]),
+                     "sum": h["sum"], "count": h["count"]}
+                 for k, h in _hists.items()}
         helps = dict(_help)
     seen = set()
     for (name, labels), v in sorted(counters.items()):
@@ -84,6 +122,22 @@ def render() -> str:
                 lines.append(f"# HELP {name} {helps[name]}")
             lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name}{_fmt_labels(labels)} {v:g}")
+    for (name, labels), h in sorted(hists.items()):
+        if name not in seen:
+            seen.add(name)
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
+            lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for b, c in zip(h["bounds"], h["counts"]):
+            cum += c
+            lt = labels + (("le", f"{b:g}"),)
+            lines.append(f"{name}_bucket{_fmt_labels(lt)} {cum}")
+        lines.append(
+            f"{name}_bucket{_fmt_labels(labels + (('le', '+Inf'),))} "
+            f"{h['count']}")
+        lines.append(f"{name}_sum{_fmt_labels(labels)} {h['sum']:g}")
+        lines.append(f"{name}_count{_fmt_labels(labels)} {h['count']}")
     for name, (help_, fn) in sorted(gauge_fns.items()):
         try:
             vals = fn()
@@ -105,6 +159,7 @@ def reset_for_testing() -> None:
         _counters.clear()
         _gauges.clear()
         _gauge_fns.clear()
+        _hists.clear()
         _help.clear()
 
 

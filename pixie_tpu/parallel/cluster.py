@@ -169,8 +169,14 @@ class LocalCluster:
         if len(items) > 1:
             from concurrent.futures import ThreadPoolExecutor
 
+            from pixie_tpu import trace as _trace
+
+            # worker threads must inherit any active trace context so their
+            # executors' op spans parent correctly (contextvars don't cross
+            # thread-pool boundaries on their own)
+            calls = [_trace.propagating_call(run_one, *kv) for kv in items]
             with ThreadPoolExecutor(max_workers=min(len(items), 16)) as pool:
-                outs = list(pool.map(lambda kv: run_one(*kv), items))
+                outs = list(pool.map(lambda c: c(), calls))
         else:
             outs = [run_one(*kv) for kv in items]
         # Deferred agent partials: per channel, either merge all agents'

@@ -312,9 +312,11 @@ def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
                                      tiled=False).reshape(n_dev)
         return exchanged, recv_counts
 
-    shard = jax.shard_map(
+    from pixie_tpu.parallel.spmd import serialize_cpu_collectives, shard_map
+
+    shard = shard_map(
         local, mesh=mesh,
         in_specs=({k: P(axis) for k in n_cols}, P(axis)),
         out_specs=({k: P(axis) for k in n_cols}, P(axis)),
     )
-    return jax.jit(shard)
+    return serialize_cpu_collectives(jax.jit(shard), mesh)

@@ -24,6 +24,35 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 AGENT_AXIS = "agents"
 
+#: jax moved shard_map out of experimental around 0.5; support both spellings
+#: (the tier-1 environment pins 0.4.x).
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax 0.4.x only
+    from jax.experimental.shard_map import shard_map
+
+#: XLA-CPU collectives rendezvous across ALL local participants; two
+#: concurrent multi-device programs in one process (concurrent agent
+#: executors in tests / LocalCluster) can split the intra-op thread pool
+#: between their rendezvous and deadlock (observed on jax 0.4.x: stuck
+#: AllReduceParticipantData waits).  Collective-bearing executions on a CPU
+#: mesh therefore serialize through one lock and block before releasing; on
+#: real accelerator meshes executions stay async and unlocked.
+_COLLECTIVE_EXEC_LOCK = __import__("threading").Lock()
+
+
+def serialize_cpu_collectives(jit_fn, mesh: Mesh):
+    if any(d.platform != "cpu" for d in mesh.devices.flat):
+        return jit_fn
+
+    def run(*args, **kwargs):
+        with _COLLECTIVE_EXEC_LOCK:
+            out = jit_fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            return out
+
+    return run
+
 
 def make_mesh(n_devices: int | None = None, axis: str = AGENT_AXIS) -> Mesh:
     devs = jax.devices()
@@ -124,13 +153,13 @@ def spmd_agg_step(raw_step, reduce_tree, mesh: Mesh, axis: str = AGENT_AXIS):
         total = lax.psum(cnt, axis)
         return merged, total
 
-    shard = jax.shard_map(
+    shard = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P(), P(), P()),
         out_specs=(P(), P()),
     )
-    return jax.jit(shard)
+    return serialize_cpu_collectives(jax.jit(shard), mesh)
 
 
 def spmd_partial_step(raw_step, init_state_fn, reduce_tree, n_limits: int,
@@ -158,13 +187,13 @@ def spmd_partial_step(raw_step, init_state_fn, reduce_tree, n_limits: int,
         )
         return collective_merge(new_state, reduce_tree, axis)
 
-    shard = jax.shard_map(
+    shard = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P(), P()),
         out_specs=P(),
     )
-    return jax.jit(shard)
+    return serialize_cpu_collectives(jax.jit(shard), mesh)
 
 
 def shard_batches(cols: dict, n_devices: int) -> dict:

@@ -1,0 +1,47 @@
+"""Repo-bundled PxL scripts (self-telemetry and other pixie_tpu-native
+scripts that have no upstream-reference counterpart).
+
+Layout mirrors the reference bundle (`<name>/<name>.pxl` + `vis.json` per
+directory) so the CLI, Web UI, and the all-scripts compile ratchet treat
+both sources uniformly: `script_dirs()` unions the reference bundle (when
+its checkout exists) with the scripts shipped here.
+"""
+from __future__ import annotations
+
+import pathlib
+
+#: the reference checkout's bundle (absent in minimal environments)
+REFERENCE_BUNDLE = pathlib.Path("/root/reference/src/pxl_scripts/px")
+#: scripts shipped inside this package
+REPO_BUNDLE = pathlib.Path(__file__).resolve().parent / "px"
+
+
+def default_bundle() -> pathlib.Path:
+    """The bundle dir CLI/Web UI default to: the reference checkout when
+    present (richer), else the repo-shipped scripts."""
+    return REFERENCE_BUNDLE if REFERENCE_BUNDLE.is_dir() else REPO_BUNDLE
+
+
+def script_dirs() -> list[pathlib.Path]:
+    """Every bundled script directory (reference ∪ repo), deduped by name
+    with the reference winning (its scripts are the compatibility target)."""
+    m = bundle_map()
+    return [m[k] for k in sorted(m)]
+
+
+def bundle_map(primary=None) -> dict[str, pathlib.Path]:
+    """name → script dir over the reference ∪ repo union, overlaid by an
+    explicit `primary` bundle dir (primary wins on name clashes).  This is
+    the single resolution surface the CLI, Web UI, and live REPL share, so
+    a script listed anywhere is loadable everywhere."""
+    out: dict[str, pathlib.Path] = {}
+    bases = [REPO_BUNDLE, REFERENCE_BUNDLE]
+    if primary is not None:
+        bases.append(pathlib.Path(primary))
+    for base in bases:
+        if not base.is_dir():
+            continue
+        for d in base.iterdir():
+            if d.is_dir() and list(d.glob("*.pxl")):
+                out[d.name] = d
+    return out

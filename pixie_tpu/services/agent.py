@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Optional
 
+from pixie_tpu import trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.parallel.partial import PartialAggBatch
 from pixie_tpu.plan.plan import Plan
@@ -65,9 +66,15 @@ class Agent:
         #: dynamic tracepoints deployed to this agent (pem TracepointManager
         #: analog, pem/tracepoint_manager.h:48)
         self.tracepoints = TracepointManager(self.store)
+        #: self-telemetry: this agent's exec spans + broker-shipped spans
+        #: land in the local spans table, created BEFORE registration so the
+        #: broker's registry knows the schema from the first handshake
+        self.tracer = trace.Tracer(name)
+        trace.ensure_table(self.store)
 
     # ---------------------------------------------------------------- lifecycle
     def start(self, timeout: float = 10.0) -> "Agent":
+        trace.register_gauges()
         if self.collector is not None:
             self.collector.start()
         self.conn = dial(*self.broker, on_frame=self._on_frame)
@@ -124,6 +131,16 @@ class Agent:
                 target=self._execute, args=(payload,), daemon=True,
                 name=f"pixie-agent-exec-{self.name}",
             ).start()
+        elif msg == "spans":
+            # broker-shipped spans (the merger holds no scanned store):
+            # append into the local spans table so one distributed scan
+            # returns the full trace.  Off the read loop — a table write
+            # must not queue execute/heartbeat frames behind telemetry.
+            threading.Thread(
+                target=self._write_shipped_spans,
+                args=(payload.get("spans") or [],), daemon=True,
+                name=f"pixie-agent-spans-{self.name}",
+            ).start()
         elif msg == "deploy_tracepoint":
             try:
                 self.tracepoints.apply([payload["spec"]])
@@ -143,31 +160,44 @@ class Agent:
                 }))
 
     def _execute(self, meta: dict):
+        import contextlib
+
         req_id = meta.get("req_id", "")
         # echoed on every result frame; the broker drops frames whose token
         # doesn't match the live query (per-query result-stream auth,
         # reference carnotpb/carnot.proto:30-96)
         qtoken = meta.get("qtoken")
+        # cross-process trace context: parent this agent's exec spans under
+        # the broker's dispatch span for the same query
+        tctx = meta.get("trace")
+        cm = (trace.root(self.tracer, "exec", ctx=tctx, agent=self.name,
+                         req_id=req_id)
+              if tctx else contextlib.nullcontext())
         try:
-            plan = Plan.from_dict(meta["plan"])
-            ex = PlanExecutor(
-                plan, self.store, self.registry,
-                analyze=bool(meta.get("analyze", False)),
-                route_scale=int(meta.get("route_scale", 1)),
-            )
-            t0 = time.perf_counter()
-            out = ex.run_agent()
-            for channel, payload in out.items():
-                extra = {"msg": "chunk", "req_id": req_id, "channel": channel,
-                         "agent": self.name, "qtoken": qtoken}
-                if isinstance(payload, PartialAggBatch):
-                    self.conn.send(wire.encode_partial_agg(payload, extra))
-                elif isinstance(payload, HostBatch):
-                    self.conn.send(wire.encode_host_batch(payload, extra))
-                else:
-                    raise TypeError(f"unexpected payload {type(payload)}")
-            stats = dict(ex.stats)
-            stats["exec_s"] = time.perf_counter() - t0
+            with cm:
+                plan = Plan.from_dict(meta["plan"])
+                ex = PlanExecutor(
+                    plan, self.store, self.registry,
+                    analyze=bool(meta.get("analyze", False)),
+                    route_scale=int(meta.get("route_scale", 1)),
+                )
+                t0 = time.perf_counter()
+                out = ex.run_agent()
+                for channel, payload in out.items():
+                    extra = {"msg": "chunk", "req_id": req_id,
+                             "channel": channel,
+                             "agent": self.name, "qtoken": qtoken}
+                    if isinstance(payload, PartialAggBatch):
+                        self.conn.send(wire.encode_partial_agg(payload, extra))
+                    elif isinstance(payload, HostBatch):
+                        self.conn.send(wire.encode_host_batch(payload, extra))
+                    else:
+                        raise TypeError(f"unexpected payload {type(payload)}")
+                stats = dict(ex.stats)
+                stats["exec_s"] = time.perf_counter() - t0
+            # spans persist BEFORE the ack: when exec_done lands at the
+            # broker, this query's spans are already scannable
+            self._flush_trace()
             from pixie_tpu.services.broker import _jsonable
 
             self.conn.send(wire.encode_json({
@@ -175,10 +205,34 @@ class Agent:
                 "qtoken": qtoken, "stats": _jsonable(stats),
             }))
         except Exception as e:
+            self._flush_trace()
             self.conn.send(wire.encode_json({
                 "msg": "exec_error", "req_id": req_id, "agent": self.name,
                 "qtoken": qtoken, "error": str(e),
             }))
+
+    def _write_shipped_spans(self, rows: list) -> None:
+        try:
+            trace.write_spans(self.store, rows)
+        except Exception:
+            from pixie_tpu import metrics as _metrics
+
+            _metrics.counter_inc(
+                "px_agent_span_write_errors_total",
+                help_="spans that failed to persist to the local store")
+
+    def _flush_trace(self) -> None:
+        """Persist buffered spans; never let telemetry failure block the
+        exec_done/exec_error ack (an unacked query stalls the broker for
+        the full query timeout)."""
+        try:
+            self.tracer.flush(store=self.store)
+        except Exception:
+            from pixie_tpu import metrics as _metrics
+
+            _metrics.counter_inc(
+                "px_agent_span_write_errors_total",
+                help_="spans that failed to persist to the local store")
 
 
 def main(argv=None):

@@ -19,6 +19,7 @@ import threading
 import traceback
 from typing import Optional
 
+from pixie_tpu import trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.parallel.distributed import DistributedPlanner
@@ -33,6 +34,10 @@ from pixie_tpu.types import Relation
 
 DEFAULT_QUERY_TIMEOUT_S = 60.0
 
+#: broker end-to-end query latency buckets (seconds)
+QUERY_LATENCY_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                        10.0, 30.0, 60.0)
+
 
 class _QueryCtx:
     def __init__(self, expected_agents: set[str], channels: set[str]):
@@ -43,6 +48,9 @@ class _QueryCtx:
         self.agent_stats: dict[str, dict] = {}
         self.error: Optional[str] = None
         self.done = threading.Event()
+        #: per-agent dispatch spans (trace.Span), opened at frame send and
+        #: closed by the exec_done/exec_error handler threads
+        self.dispatch_spans: dict[str, object] = {}
         #: per-query auth token: agents must echo it on every result chunk
         #: and completion frame, so a stale/confused/malicious producer
         #: cannot inject rows into another query's stream (reference: the
@@ -82,6 +90,9 @@ class Broker:
         self.udf_registry = registry
         self.query_timeout_s = query_timeout_s
         self.merger_store = TableStore()
+        #: self-telemetry spans for the query path; shipped to an agent's
+        #: spans table at query end (the broker holds no scanned store)
+        self.tracer = trace.Tracer("broker")
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
@@ -158,6 +169,7 @@ class Broker:
             lambda: {(): float(len(self.registry.live_agents()))},
             "agents currently live in the registry",
         )
+        trace.register_gauges()
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
@@ -380,11 +392,20 @@ class Broker:
             return
         ctx.payloads.setdefault(meta["channel"], []).append(payload)
 
+    def _finish_dispatch_span(self, ctx: _QueryCtx, agent,
+                              error: Optional[str] = None) -> None:
+        sp = ctx.dispatch_spans.pop(agent, None)
+        if sp is not None:
+            if error:
+                sp.attributes["error"] = error[:200]
+            self.tracer.finish(sp)
+
     def _handle_exec_done(self, meta: dict):
         ctx = self._ctx(meta)
         if ctx is None:
             return
         ctx.agent_stats[meta["agent"]] = meta.get("stats", {})
+        self._finish_dispatch_span(ctx, meta["agent"])
         ctx.pending_agents.discard(meta["agent"])
         if not ctx.pending_agents:
             ctx.done.set()
@@ -394,42 +415,84 @@ class Broker:
         if ctx is None:
             return
         ctx.error = f"agent {meta.get('agent')}: {meta.get('error')}"
+        self._finish_dispatch_span(ctx, meta.get("agent"),
+                                   error=str(meta.get("error")))
         ctx.done.set()
 
     # ------------------------------------------------------------------- query
     def _run_query(self, client: Connection, meta: dict):
         req_id = meta.get("req_id", "")
         try:
-            results, stats = self.execute_script(
-                meta["script"],
-                func=meta.get("func"),
-                func_args=meta.get("func_args"),
-                now=meta.get("now"),
-                default_limit=meta.get("default_limit"),
-                analyze=bool(meta.get("analyze", False)),
-                funcs=[tuple(f) for f in meta.get("funcs") or []] or None,
-            )
-            for name, qr in results.items():
-                hb = HostBatch(
-                    dtypes={n: qr.relation.dtype(n) for n in qr.relation.names()},
-                    dicts=qr.dictionaries,
-                    cols=qr.columns,
+            with trace.root(self.tracer, "query", req_id=req_id):
+                results, stats = self.execute_script(
+                    meta["script"],
+                    func=meta.get("func"),
+                    func_args=meta.get("func_args"),
+                    now=meta.get("now"),
+                    default_limit=meta.get("default_limit"),
+                    analyze=bool(meta.get("analyze", False)),
+                    funcs=[tuple(f) for f in meta.get("funcs") or []] or None,
                 )
-                client.send(wire.encode_host_batch(
-                    hb, {"msg": "result_chunk", "req_id": req_id,
-                         "table": name,
-                         # semantic types ride the wire with the relation
-                         "relation": qr.relation.to_dict()}
-                ))
-            client.send(wire.encode_json(
-                {"msg": "done", "req_id": req_id, "stats": _jsonable(stats)}
-            ))
+                with trace.span("render"):
+                    for name, qr in results.items():
+                        hb = HostBatch(
+                            dtypes={n: qr.relation.dtype(n)
+                                    for n in qr.relation.names()},
+                            dicts=qr.dictionaries,
+                            cols=qr.columns,
+                        )
+                        client.send(wire.encode_host_batch(
+                            hb, {"msg": "result_chunk", "req_id": req_id,
+                                 "table": name,
+                                 # semantic types ride the wire with the
+                                 # relation
+                                 "relation": qr.relation.to_dict()}
+                        ))
+                    client.send(wire.encode_json(
+                        {"msg": "done", "req_id": req_id,
+                         "stats": _jsonable(stats)}
+                    ))
         except Exception as e:  # compile/plan/exec errors all surface to client
             if not isinstance(e, PxError):
                 traceback.print_exc()
             client.send(wire.encode_json(
                 {"msg": "error", "req_id": req_id, "error": str(e)}
             ))
+        finally:
+            self._ship_spans()
+
+    def _ship_spans(self) -> None:
+        """Persist this broker's finished spans into the data plane: the rows
+        go to one live agent's `self_telemetry.spans` table, so the normal
+        distributed scan path (and any PxL script) sees the full trace —
+        broker spans included — without the broker holding a scanned store.
+
+        Runs in query finally-blocks: telemetry failure (agent churn racing
+        the conn map, dead sockets) must never replace a query's outcome, so
+        everything is counted instead of raised."""
+        from pixie_tpu import metrics as _metrics
+
+        try:
+            if self.tracer.buffered == 0:
+                return
+            # snapshot: the expiry thread pops entries concurrently
+            conns = dict(self._agent_conns)
+
+            def send(rows):
+                for name in sorted(conns):
+                    c = conns[name]
+                    if not c.closed and c.send(
+                            wire.encode_json({"msg": "spans", "spans": rows})):
+                        return
+                _metrics.counter_inc(
+                    "px_broker_trace_spans_unshipped_total", float(len(rows)),
+                    help_="broker spans dropped: no agent accepted them")
+
+            self.tracer.flush(send=send)
+        except Exception:
+            _metrics.counter_inc(
+                "px_broker_trace_ship_errors_total",
+                help_="unexpected failures shipping broker spans")
 
     def _deploy_mutations(self, mutations: list) -> None:
         from pixie_tpu.status import Unavailable
@@ -479,18 +542,33 @@ class Broker:
         run once — reference optimizer.h:39 MergeNodesRule); the returned
         stats carry `sink_map` so the caller splits results per widget.
         """
+        import time as _time
+
         from pixie_tpu import metrics as _metrics
 
         _metrics.counter_inc("px_broker_queries_total",
                              help_="ExecuteScript requests served")
+        # In-process callers (cron, tests) get their own trace root; under
+        # the networked path _run_query's root is already active and this is
+        # a no-op.  Shipping happens only when this frame owns the root.
+        owns_root = trace.enabled() and trace.current() is None
+        t0 = _time.perf_counter()
         try:
-            return self._execute_script_inner(
-                script, func, func_args, now, default_limit, analyze, funcs
-            )
+            with trace.maybe_root(self.tracer, "query"):
+                return self._execute_script_inner(
+                    script, func, func_args, now, default_limit, analyze, funcs
+                )
         except Exception:
             _metrics.counter_inc("px_broker_query_errors_total",
                                  help_="ExecuteScript requests that failed")
             raise
+        finally:
+            _metrics.histogram_observe(
+                "px_broker_query_latency_seconds",
+                _time.perf_counter() - t0, QUERY_LATENCY_BOUNDS,
+                help_="broker end-to-end ExecuteScript latency")
+            if owns_root:
+                self._ship_spans()
 
     def _execute_script_inner(
         self, script, func, func_args, now, default_limit, analyze,
@@ -508,27 +586,30 @@ class Broker:
         if not any(a.has_data_store for a in spec.agents):
             raise Unavailable("no live data agents registered")
         sink_map = None
-        if funcs:
-            q, sink_map = compile_pxl_funcs(
-                script, self.registry.combined_schemas(),
-                [(p, f, a) for p, f, a in funcs],
-                registry=self.udf_registry, now=now,
-                default_limit=default_limit,
-            )
-        else:
-            q = compile_pxl(
-                script, self.registry.combined_schemas(), func=func,
-                func_args=func_args, registry=self.udf_registry, now=now,
-                default_limit=default_limit,
-            )
+        with trace.span("compile"):
+            if funcs:
+                q, sink_map = compile_pxl_funcs(
+                    script, self.registry.combined_schemas(),
+                    [(p, f, a) for p, f, a in funcs],
+                    registry=self.udf_registry, now=now,
+                    default_limit=default_limit,
+                )
+            else:
+                q = compile_pxl(
+                    script, self.registry.combined_schemas(), func=func,
+                    func_args=func_args, registry=self.udf_registry, now=now,
+                    default_limit=default_limit,
+                )
         if q.mutations:
             # Deploy tracepoints to every live agent and wait for readiness
             # (reference MutationExecutor: register → agents deploy → poll
             # isSchemaReady, mutation_executor.go:84,272).
-            self.tracepoints.apply(q.mutations)
-            self._deploy_mutations(q.mutations)
+            with trace.span("deploy_mutations"):
+                self.tracepoints.apply(q.mutations)
+                self._deploy_mutations(q.mutations)
             spec = self.registry.cluster_spec()  # schemas refreshed by re-register
-        dp = DistributedPlanner(spec).plan(q.plan)
+        with trace.span("plan_split"):
+            dp = DistributedPlanner(spec).plan(q.plan)
 
         with self._qlock:
             self._req_counter += 1
@@ -540,9 +621,18 @@ class Broker:
                 conn = self._agent_conns.get(agent_name)
                 if conn is None or conn.closed:
                     raise Unavailable(f"agent {agent_name} not connected")
+                # one dispatch span per agent: opened at send, closed by the
+                # exec_done/exec_error handler; its id rides the wire so the
+                # agent's exec spans parent under it across processes
+                dsp = trace.start_child("dispatch", agent=agent_name)
+                tctx = None
+                if dsp is not None:
+                    ctx.dispatch_spans[agent_name] = dsp
+                    tctx = {"trace_id": dsp.trace_id, "span_id": dsp.span_id}
                 conn.send(wire.encode_json({
                     "msg": "execute", "req_id": req_id,
                     "qtoken": ctx.token,
+                    "trace": tctx,
                     "plan": plan.to_dict(), "analyze": analyze,
                     # distributed fan-out: agents route CPU/TPU by the
                     # query's total size, not their local shard's
@@ -556,66 +646,74 @@ class Broker:
             if ctx.error:
                 raise Unavailable(ctx.error)
 
-            reg = self.udf_registry
-            if reg is None:
-                from pixie_tpu.udf import registry as reg
-            from pixie_tpu.parallel.repartition import (
-                bucket_channels,
-                run_join_stages,
-                stage_output_inputs,
-            )
+            with trace.span("merge"):
+                reg = self.udf_registry
+                if reg is None:
+                    from pixie_tpu.udf import registry as reg
+                from pixie_tpu.parallel.repartition import (
+                    bucket_channels,
+                    run_join_stages,
+                    stage_output_inputs,
+                )
 
-            if dp.join_stages:
-                # repartitioned joins run partition-parallel on the merger
-                # (the Kelvin role); bucket channels are consumed here, with
-                # the same payload-shape contract as rows channels
-                run_join_stages(dp, ctx.payloads, reg,
-                                store=self.merger_store, analyze=analyze)
-            consumed = bucket_channels(dp)
-            inputs: dict[str, HostBatch] = {}
-            for cid, ch in dp.channels.items():
-                if cid in consumed:
-                    continue
-                got = ctx.payloads.get(cid, [])
-                if not got:
-                    raise Internal(f"channel {cid} received no payloads")
-                if ch.kind == "agg_state":
-                    if not all(isinstance(p, PartialAggBatch) for p in got):
-                        raise Internal(f"channel {cid}: expected agg_state payloads")
-                    inputs[cid] = merge_partials(ch.agg, got, reg)
-                else:
-                    if not all(isinstance(p, HostBatch) for p in got):
-                        raise Internal(f"channel {cid}: expected row payloads")
-                    inputs[cid] = _union_host_batches(got)
-            inputs.update(stage_output_inputs(dp, ctx.payloads))
+                if dp.join_stages:
+                    # repartitioned joins run partition-parallel on the merger
+                    # (the Kelvin role); bucket channels are consumed here, with
+                    # the same payload-shape contract as rows channels
+                    run_join_stages(dp, ctx.payloads, reg,
+                                    store=self.merger_store, analyze=analyze)
+                consumed = bucket_channels(dp)
+                inputs: dict[str, HostBatch] = {}
+                for cid, ch in dp.channels.items():
+                    if cid in consumed:
+                        continue
+                    got = ctx.payloads.get(cid, [])
+                    if not got:
+                        raise Internal(f"channel {cid} received no payloads")
+                    if ch.kind == "agg_state":
+                        if not all(isinstance(p, PartialAggBatch) for p in got):
+                            raise Internal(f"channel {cid}: expected agg_state payloads")
+                        with trace.span("partial_merge", channel=cid,
+                                        producers=len(got)):
+                            inputs[cid] = merge_partials(ch.agg, got, reg)
+                    else:
+                        if not all(isinstance(p, HostBatch) for p in got):
+                            raise Internal(f"channel {cid}: expected row payloads")
+                        inputs[cid] = _union_host_batches(got)
+                inputs.update(stage_output_inputs(dp, ctx.payloads))
 
-            from pixie_tpu.udf.udtf import UDTFContext
+                from pixie_tpu.udf.udtf import UDTFContext
 
-            ex = PlanExecutor(
-                dp.merger_plan, self.merger_store, self.udf_registry,
-                inputs=inputs, analyze=analyze,
-                udtf_ctx=UDTFContext(
-                    table_store=self.merger_store, registry=reg,
-                    agent_registry=self.registry,
-                    tracepoint_manager=self.tracepoints,
-                ),
-            )
-            results = ex.run()
-            # The merger plan's sources are channels (no STs); the LOGICAL
-            # plan + agent schemas determine them.
-            from pixie_tpu.engine.semantics import SchemaStore, restamp_result
+                ex = PlanExecutor(
+                    dp.merger_plan, self.merger_store, self.udf_registry,
+                    inputs=inputs, analyze=analyze,
+                    udtf_ctx=UDTFContext(
+                        table_store=self.merger_store, registry=reg,
+                        agent_registry=self.registry,
+                        tracepoint_manager=self.tracepoints,
+                    ),
+                )
+                results = ex.run()
+                # The merger plan's sources are channels (no STs); the LOGICAL
+                # plan + agent schemas determine them.
+                from pixie_tpu.engine.semantics import SchemaStore, restamp_result
 
-            sstore = SchemaStore(self.registry.combined_schemas())
-            for r in results.values():
-                restamp_result(r, q.plan, sstore, reg)
-            stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
-            if sink_map is not None:
-                stats["sink_map"] = sink_map
-                stats["merger"]["operators"] = ex.op_stats
-            for r in results.values():
-                r.exec_stats["agents"] = ctx.agent_stats
+                sstore = SchemaStore(self.registry.combined_schemas())
+                for r in results.values():
+                    restamp_result(r, q.plan, sstore, reg)
+                stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+                if sink_map is not None:
+                    stats["sink_map"] = sink_map
+                    stats["merger"]["operators"] = ex.op_stats
+                for r in results.values():
+                    r.exec_stats["agents"] = ctx.agent_stats
             return results, stats
         finally:
+            # span hygiene: a timeout / disconnect / error leaves dispatch
+            # spans without an exec_done to close them
+            for agent_name in list(ctx.dispatch_spans):
+                self._finish_dispatch_span(ctx, agent_name,
+                                           error=ctx.error or "unresolved")
             with self._qlock:
                 self._queries.pop(req_id, None)
 

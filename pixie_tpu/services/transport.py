@@ -77,10 +77,18 @@ class Connection:
         self._thread.start()
 
     def _read_loop(self):
+        from pixie_tpu import metrics as _metrics
+
         while True:
             frame = recv_frame(self.sock)
             if frame is None:
                 break
+            _metrics.counter_inc(
+                "px_transport_frames_received_total",
+                help_="frames received over framed-TCP connections")
+            _metrics.counter_inc(
+                "px_transport_bytes_received_total", float(len(frame)),
+                help_="frame bytes received over framed-TCP connections")
             try:
                 self._on_frame(self, frame)
             except Exception:
@@ -91,12 +99,20 @@ class Connection:
         self.close()
 
     def send(self, frame: bytes) -> bool:
+        from pixie_tpu import metrics as _metrics
+
         with self._wlock:
             try:
                 send_frame(self.sock, frame)
-                return True
             except OSError:
                 return False
+        _metrics.counter_inc(
+            "px_transport_frames_sent_total",
+            help_="frames sent over framed-TCP connections")
+        _metrics.counter_inc(
+            "px_transport_bytes_sent_total", float(len(frame)),
+            help_="frame bytes sent over framed-TCP connections")
+        return True
 
     def close(self):
         if self._closed.is_set():

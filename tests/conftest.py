@@ -18,7 +18,12 @@ if "--xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS host-platform device count above already
+    # provides the 8 virtual CPU devices
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -27,3 +32,15 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+#: shared marker for tests that read the reference pxl_scripts checkout
+def _reference_mounted() -> bool:
+    from pixie_tpu.scripts import REFERENCE_BUNDLE
+
+    return REFERENCE_BUNDLE.is_dir()
+
+
+requires_reference = pytest.mark.skipif(
+    not _reference_mounted(),
+    reason="reference pxl_scripts checkout not mounted")

@@ -25,6 +25,9 @@ from pixie_tpu.metadata.state import (
 )
 
 SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
+#: repo-shipped scripts (self-telemetry etc.) join the ratchet — the 60
+#: reference scripts plus px/self_query_latency make it 61/61
+from pixie_tpu.scripts import script_dirs as _bundled_script_dirs  # noqa: E402
 
 #: scripts expected NOT to compile yet: {name: reason}
 XFAIL: dict[str, str] = {}
@@ -55,9 +58,9 @@ _TYPE_DEFAULTS = {
 
 
 def _script_dirs():
-    return sorted(
-        d for d in SCRIPTS.iterdir() if d.is_dir() and list(d.glob("*.pxl"))
-    )
+    # pixie_tpu.scripts.script_dirs() unions the reference bundle (when its
+    # checkout exists) with the repo-shipped scripts, deduped by name
+    return _bundled_script_dirs()
 
 
 def _source_of(d: pathlib.Path) -> str:

@@ -7,6 +7,9 @@ import pytest
 from pixie_tpu.cli_live import LiveSession
 from pixie_tpu.webui import DEFAULT_SCRIPTS, local_runner
 
+#: these tests drive reference-bundle scripts (http_data, cluster, ...)
+from tests.conftest import requires_reference
+
 
 @pytest.fixture(scope="module")
 def session():
@@ -25,10 +28,12 @@ class TestCompletion:
         assert session.complete("s", "s") == ["scripts", "set"]
         assert session.complete("wa", "wa") == ["watch"]
 
+    @requires_reference
     def test_script_completion_after_use(self, session):
         got = session.complete("http_", "use http_")
         assert "http_data" in got and "http_data_filtered" in got
 
+    @requires_reference
     def test_variable_completion_after_set(self, session):
         session.handle_line("use http_data")
         got = session.complete("start", "set start")
@@ -36,14 +41,17 @@ class TestCompletion:
 
 
 class TestCommands:
+    @requires_reference
     def test_scripts_filter(self, session):
         out = session.handle_line("scripts kafka")
         assert "kafka_data" in out and "http_data" not in out
 
+    @requires_reference
     def test_use_shows_args(self, session):
         out = session.handle_line("use http_data")
         assert "start_time" in out and "'-5m'" in out
 
+    @requires_reference
     def test_set_and_args_roundtrip(self, session):
         session.handle_line("use http_data")
         assert session.handle_line("set start_time=-2m") == \
@@ -54,12 +62,14 @@ class TestCommands:
         out = session.handle_line("use nope_nope")
         assert "unknown script" in out
 
+    @requires_reference
     def test_run_renders_widgets(self, session):
         session.handle_line("use http_data")
         out = session.handle_line("run")
         assert "== http_data" in out
         assert "rows)" in out and "ms)" in out
 
+    @requires_reference
     def test_run_with_inline_script(self, session):
         out = session.handle_line("run cluster")
         assert "== " in out and "ms)" in out

@@ -17,6 +17,8 @@ import time
 
 import pytest
 
+from tests.conftest import requires_reference as _requires_reference
+
 from pixie_tpu.collect.protocols.base import ConnTracker, MessageType, ParseState
 from pixie_tpu.collect.protocols.http2 import (
     DATA,
@@ -336,6 +338,7 @@ def test_real_grpc_capture_parses():
     assert row["resp_message"] == "grpc-status: 0"
 
 
+@_requires_reference
 def test_http2_raw_bytes_to_bundled_script():
     """http2 frames fed as RAW BYTES through the tracer populate http_events,
     and the bundled px/http_data script reads them (major_version=2 rows)."""

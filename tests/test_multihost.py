@@ -27,7 +27,7 @@ def test_global_mesh_spans_all_devices_and_runs_collectives():
     assert (lo, hi) == (0, 8)  # single process owns the whole axis
 
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax import shard_map
+    from pixie_tpu.parallel.spmd import shard_map
 
     def local_sum(x):
         return jax.lax.psum(jnp.sum(x), axis_name=mesh.axis_names[0])

@@ -50,7 +50,7 @@ WORKER = textwrap.dedent("""\
     garr = jax.make_array_from_process_local_data(
         sharding, local, global_shape=(4,))
 
-    from jax import shard_map
+    from pixie_tpu.parallel.spmd import shard_map
 
     def partial_sum(x):
         return jax.lax.psum(jnp.sum(x), axis_name=axis)
@@ -102,6 +102,10 @@ def test_two_process_distributed_mesh_and_partial_agg(tmp_path):
             for q in procs:
                 q.kill()
             pytest.fail("distributed worker timed out")
+        if "Multiprocess computations aren't implemented on the CPU" in err:
+            for q in procs:
+                q.kill()
+            pytest.skip("this jaxlib lacks multi-process CPU collectives")
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         outs.append(json.loads(out.strip().splitlines()[-1]))
     assert {o["pid"] for o in outs} == {0, 1}

@@ -13,6 +13,8 @@ import textwrap
 
 import pytest
 
+from tests.conftest import requires_reference as _requires_reference
+
 from pixie_tpu.obj_tools import ElfReader, NativeSymbolizer
 from pixie_tpu.status import CompilerError
 
@@ -149,6 +151,7 @@ class TestPxtraceValidation:
         q = self._compile(ok, probe="pxtrace.uprobe()")
         assert q.mutations
 
+    @_requires_reference
     def test_reference_tcp_drops_program_compiles(self):
         """The actual bundled tcp_drops bpftrace program validates clean."""
         import pathlib

@@ -13,6 +13,8 @@ from pixie_tpu.collect.perf_profiler import PerfProfilerConnector, fold_stack
 from pixie_tpu.compiler import compile_pxl
 from pixie_tpu.engine import execute_plan
 
+from tests.conftest import requires_reference as _requires_reference
+
 
 def busy_marker_function(stop):
     x = 0
@@ -68,6 +70,7 @@ def test_profiler_samples_busy_thread_and_feeds_table():
     assert marked["cnt"].sum() >= 20
 
 
+@_requires_reference
 def test_perf_flamegraph_script_runs_on_profiler_data():
     """The bundled perf_flamegraph script executes over real profiler rows."""
     import json

@@ -10,6 +10,8 @@ import threading
 
 import pytest
 
+from tests.conftest import requires_reference as _requires_reference
+
 from pixie_tpu.collect.protocols import (
     ConnTracker,
     MessageType,
@@ -606,6 +608,7 @@ class TestTracer:
             tap.stop()
             srv.close()
 
+    @_requires_reference
     def test_raw_bytes_to_bundled_scripts(self):
         """VERDICT r2 task-2 'done' bar: px/{mysql,pgsql,dns,redis}_data
         execute against tables populated from RAW BYTES via the tracer —

@@ -26,6 +26,10 @@ from pixie_tpu.metadata.state import global_manager, set_global_manager
 from pixie_tpu.testing import build_demo_store, demo_metadata
 
 SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
+
+pytestmark = pytest.mark.skipif(
+    not SCRIPTS.is_dir(),
+    reason="reference pxl_scripts checkout not mounted")
 SEC = 1_000_000_000
 NOW = 600 * SEC
 #: below every script's head() default (1000 / 100 with a narrower window), so

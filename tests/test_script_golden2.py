@@ -41,6 +41,10 @@ from tests.test_script_golden import (
 ROWS = 800
 WINDOW = 10 * SEC
 
+pytestmark = pytest.mark.skipif(
+    not SCRIPTS.is_dir(),
+    reason="reference pxl_scripts checkout not mounted")
+
 
 @pytest.fixture(scope="module", autouse=True)
 def demo_cluster():

@@ -1,0 +1,138 @@
+"""Golden-VALUE execution parity for the repo-bundled px/self_query_latency
+script (the test_script_golden2.py pattern applied to the self-telemetry
+table): a pandas oracle independently recomputes each vis func over the same
+span rows, and the engine's output must match value-for-value.  Quantiles
+(px.p50/px.p99 = log-histogram sketch, gamma=1.02) compare with a relative
+tolerance; counts and sums must match exactly."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu import trace
+from pixie_tpu.collect.schemas import all_schemas
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.scripts import REPO_BUNDLE
+from pixie_tpu.table import TableStore
+from tests.test_script_golden import assert_frames
+
+SEC = 1_000_000_000
+NOW = 600 * SEC
+APPROX_Q = ("latency_p50", "latency_p99")
+
+SCRIPT_DIR = REPO_BUNDLE / "self_query_latency"
+
+
+def _span_rows() -> list[dict]:
+    """Deterministic span population: 3 services × several span names with
+    varied durations, all inside the -5m window; one old row outside it."""
+    rng = np.random.default_rng(7)
+    rows = []
+    names_by_service = {
+        "broker": ["query", "compile", "plan_split", "dispatch", "merge"],
+        "pem1": ["exec", "scan(http_events)->partial_agg", "readback_wave"],
+        "pem2": ["exec", "scan(http_events)->partial_agg", "readback_wave"],
+    }
+    i = 0
+    for service, names in names_by_service.items():
+        for name in names:
+            for _ in range(int(rng.integers(3, 9))):
+                start = NOW - int(rng.integers(1, 290)) * SEC
+                rows.append({
+                    "time_": start,
+                    "trace_id": f"{i:032x}",
+                    "span_id": f"{i:016x}",
+                    "parent_span_id": "",
+                    "name": name,
+                    "service": service,
+                    "duration_ns": int(rng.integers(10_000, 50_000_000)),
+                    "attributes": "",
+                })
+                i += 1
+    # outside the window: must NOT appear in either func's output
+    rows.append({
+        "time_": NOW - 3600 * SEC, "trace_id": "f" * 32, "span_id": "f" * 16,
+        "parent_span_id": "", "name": "query", "service": "broker",
+        "duration_ns": 10**12, "attributes": "",
+    })
+    return rows
+
+
+@pytest.fixture(scope="module")
+def spans_store():
+    ts = TableStore()
+    trace.write_spans(ts, _span_rows())
+    return ts
+
+
+def _run_func(store, func: str, args: dict):
+    src = (SCRIPT_DIR / "self_query_latency.pxl").read_text()
+    q = compile_pxl(src, all_schemas(), func=func, func_args=args, now=NOW)
+    results = execute_plan(q.plan, store)
+    assert len(results) == 1, sorted(results)
+    return next(iter(results.values()))
+
+
+def _oracle_df() -> pd.DataFrame:
+    df = pd.DataFrame(_span_rows())
+    return df[df["time_"] >= NOW - 300 * SEC]
+
+
+def _q(groupby, q: float):
+    # rank-based quantile matching the engine's log-histogram semantics
+    # (tests/test_script_golden2.py `_q`)
+    return groupby.apply(lambda s: np.quantile(
+        np.asarray(s, dtype=np.float64), q, method="inverted_cdf"))
+
+
+def test_span_latency_golden(spans_store):
+    res = _run_func(spans_store, "span_latency", {"start_time": "-5m"})
+    df = _oracle_df()
+    exp = df.groupby(["service", "name"], as_index=False).agg(
+        count=("duration_ns", "count"),
+        total_ns=("duration_ns", "sum"))
+    dur = df.groupby(["service", "name"])["duration_ns"]
+    exp["latency_p50"] = np.floor(_q(dur, 0.5).to_numpy())
+    exp["latency_p99"] = np.floor(_q(dur, 0.99).to_numpy())
+    assert_frames(res, exp, approx=APPROX_Q, rtol=0.05)
+
+
+def test_query_latency_golden(spans_store):
+    res = _run_func(spans_store, "query_latency", {"start_time": "-5m"})
+    df = _oracle_df()
+    df = df[df["name"] == "query"]
+    exp = df.groupby("service", as_index=False).agg(
+        queries=("duration_ns", "count"))
+    dur = df.groupby("service")["duration_ns"]
+    exp["latency_p50"] = np.floor(_q(dur, 0.5).to_numpy())
+    exp["latency_p99"] = np.floor(_q(dur, 0.99).to_numpy())
+    assert_frames(res, exp, approx=APPROX_Q, rtol=0.05)
+
+
+def test_vis_json_funcs_cover_both_widgets():
+    vis = json.loads((SCRIPT_DIR / "vis.json").read_text())
+    funcs = {w["func"]["name"] for w in vis["widgets"]}
+    assert funcs == {"span_latency", "query_latency"}
+    assert vis["variables"][0]["name"] == "start_time"
+
+
+def test_live_tracer_rows_satisfy_script(spans_store):
+    """Dogfood: rows produced by the REAL tracer (not synthetic dicts) flow
+    through the same script path."""
+    ts = TableStore()
+    tr = trace.Tracer("live")
+    with trace.root(tr, "query"):
+        with trace.span("compile"):
+            pass
+    tr.flush(store=ts)
+    src = (SCRIPT_DIR / "self_query_latency.pxl").read_text()
+    q = compile_pxl(src, all_schemas(), func="query_latency",
+                    func_args={"start_time": "-5m"}, now=time.time_ns())
+    out = next(iter(execute_plan(q.plan, ts).values())).to_pandas()
+    assert out["service"].tolist() == ["live"]
+    assert int(out["queries"].iloc[0]) == 1

@@ -191,7 +191,8 @@ def cluster():
 
 def test_broker_distributed_query_matches_local(cluster):
     broker, stores, agents, client = cluster
-    assert set(client.schemas()) == {"http_events"}
+    # every agent also carries the self-telemetry spans table
+    assert set(client.schemas()) == {"http_events", "self_telemetry.spans"}
     res = client.execute_script(SCRIPT)["out"]
     # oracle: LocalCluster over the same stores
     from pixie_tpu.parallel.cluster import LocalCluster

@@ -130,8 +130,10 @@ def test_collective_merge_tree():
         "avg": {"sum": np.ones(4), "count": np.full(4, 2.0)},
         "lo": np.array([3.0, 1.0, 2.0, 5.0]),
     }
+    from pixie_tpu.parallel.spmd import shard_map
+
     out = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(P("agents"),), out_specs=P())
+        shard_map(f, mesh=mesh, in_specs=(P("agents"),), out_specs=P())
     )(state)
     assert int(out["cnt"][0]) == 6
     assert float(out["lo"][0]) == 1.0

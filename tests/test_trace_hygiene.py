@@ -1,0 +1,104 @@
+"""Tier-1 span-hygiene ratchet: a representative distributed query must
+leave (a) no span open, (b) no dangling parent_span_id, and (c) a disabled
+PL_TRACING_ENABLED flag must keep instrumentation overhead under 5% of the
+query's wall time.
+
+The 5% bound is enforced deterministically: the per-call cost of every
+DISABLED instrumentation site (one ContextVar read) is microbenchmarked and
+multiplied by the number of sites the SAME query exercises when enabled
+(its span count), then compared against the measured disabled-run wall
+time.  That bounds what tracing adds when off without racing CI noise on
+two end-to-end timings."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pixie_tpu import flags, trace
+from tests.test_trace_distributed import (
+    QUERY,
+    _all_span_rows,
+    _mkstore,
+    _wait_for_root,
+)
+from pixie_tpu.services.agent import Agent
+from pixie_tpu.services.broker import Broker
+
+
+@pytest.fixture
+def cluster():
+    flags.set_for_testing("PL_TRACING_ENABLED", True)
+    now_ns = time.time_ns()
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=30.0).start()
+    stores = {"pem1": _mkstore(1, now_ns), "pem2": _mkstore(2, now_ns)}
+    agents = [Agent(n, "127.0.0.1", broker.port, store=s,
+                    heartbeat_s=1.0).start() for n, s in stores.items()]
+    yield broker, stores, agents
+    flags.set_for_testing("PL_TRACING_ENABLED", True)
+    for a in agents:
+        a.stop()
+    broker.stop()
+
+
+def test_span_hygiene_after_representative_query(cluster):
+    broker, stores, agents = cluster
+    res, _stats = broker.execute_script(QUERY)
+    assert res["out"].num_rows == 2
+    rows = _wait_for_root(stores, min_spans=8)
+
+    # (a) nothing left open, nothing dropped
+    for tr in [broker.tracer] + [a.tracer for a in agents]:
+        assert tr.open_spans == 0, tr.service
+        assert tr.dropped == 0, tr.service
+
+    # (b) per trace: exactly one root, every parent_span_id resolves
+    by_trace: dict[str, list[dict]] = {}
+    for r in rows:
+        by_trace.setdefault(r["trace_id"], []).append(r)
+    for tid, spans in by_trace.items():
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans if not s["parent_span_id"]]
+        assert len(roots) == 1, (tid, [s["name"] for s in roots])
+        for s in spans:
+            if s["parent_span_id"]:
+                assert s["parent_span_id"] in ids, (tid, s["name"])
+
+
+def test_disabled_tracing_overhead_under_5pct(cluster):
+    broker, stores, agents = cluster
+    # enabled run: count the instrumentation sites this query exercises
+    started0 = broker.tracer.started + sum(a.tracer.started for a in agents)
+    broker.execute_script(QUERY)
+    n_sites = (broker.tracer.started + sum(a.tracer.started for a in agents)
+               - started0)
+    assert n_sites >= 8
+    _wait_for_root(stores, min_spans=8)
+
+    flags.set_for_testing("PL_TRACING_ENABLED", False)
+    started1 = broker.tracer.started + sum(a.tracer.started for a in agents)
+    rows1 = len(_all_span_rows(stores))
+    t0 = time.perf_counter()
+    broker.execute_script(QUERY)
+    disabled_wall_s = time.perf_counter() - t0
+    # disabled ⇒ zero spans recorded anywhere
+    assert (broker.tracer.started
+            + sum(a.tracer.started for a in agents)) == started1
+    assert len(_all_span_rows(stores)) == rows1
+
+    # per-site disabled cost: the child-site fast path (span cm enter/exit,
+    # event_span, current) with no active context
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("x"):
+            pass
+        trace.event_span("y", 0, 1)
+        trace.current()
+    per_site_s = (time.perf_counter() - t0) / (3 * n)
+
+    overhead_s = per_site_s * n_sites
+    assert overhead_s < 0.05 * disabled_wall_s, (
+        f"disabled tracing overhead {overhead_s * 1e6:.1f}us exceeds 5% of "
+        f"query wall {disabled_wall_s * 1e3:.1f}ms ({n_sites} sites at "
+        f"{per_site_s * 1e9:.0f}ns)")

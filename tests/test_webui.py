@@ -12,6 +12,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+from tests.conftest import requires_reference as _requires_reference
+
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.table.dictionary import Dictionary
 from pixie_tpu.types import (
@@ -154,10 +156,16 @@ def _post(server, path, body: dict, token=None, origin=None):
 def test_index_lists_bundled_scripts(server):
     code, body = _get(server, "/")
     assert code == 200
-    assert '/script/http_data' in body
-    assert '/script/cluster' in body
+    from pixie_tpu.scripts import REFERENCE_BUNDLE
+
+    if REFERENCE_BUNDLE.is_dir():
+        assert '/script/http_data' in body
+        assert '/script/cluster' in body
+    else:
+        assert '/script/self_query_latency' in body
 
 
+@_requires_reference
 def test_script_page_embeds_source_vars_and_token(server):
     code, body = _get(server, "/script/http_data")
     assert code == 200
@@ -172,6 +180,7 @@ def test_script_page_404(server):
     assert ei.value.code == 404
 
 
+@_requires_reference
 def test_run_api_executes_and_renders_widgets(server):
     code, out = _post(server, "/api/run",
                       {"script": "http_data", "vars": {}},
@@ -183,6 +192,7 @@ def test_run_api_executes_and_renders_widgets(server):
                for w in out["widgets"])
 
 
+@_requires_reference
 def test_run_api_edited_source_reruns(server):
     # the edited source redefines the vis func (http_data) in place — the
     # Live View's edit-and-rerun loop keeps the vis spec, swaps the script
@@ -241,6 +251,7 @@ def test_run_api_rejects_cross_origin(server):
     assert "cross-origin" in out["error"]
 
 
+@_requires_reference
 def test_broker_runner_end_to_end():
     """The OTHER runner path: Live View backed by a real broker+agent
     cluster (fused multi-widget execution over the wire)."""
