@@ -24,7 +24,9 @@ from typing import Callable, Optional
 
 from pixie_tpu.types import SemanticType as ST
 
-DEFAULT_SCRIPTS = pathlib.Path("/root/reference/src/pxl_scripts/px")
+from pixie_tpu.scripts import default_bundle
+
+DEFAULT_SCRIPTS = default_bundle()
 
 #: entity semantic types → drill-down script + arg name (the reference's
 #: script_reference deep links, px/http_data/data.pxl add_source_dest_links)
@@ -396,10 +398,9 @@ class LiveServer:
 
     # ----------------------------------------------------------------- pages
     def _script_names(self) -> list[str]:
-        return sorted(
-            d.name for d in self.scripts_dir.iterdir()
-            if d.is_dir() and list(d.glob("*.pxl"))
-        )
+        from pixie_tpu.scripts import bundle_map
+
+        return sorted(bundle_map(self.scripts_dir))
 
     def index_page(self) -> str:
         links = "".join(
@@ -409,11 +410,15 @@ class LiveServer:
 
     def _load(self, name: str):
         # script names are single bundle-dir components; anything with path
-        # separators or leading dots could traverse out of scripts_dir
+        # separators or leading dots could traverse out of the bundles —
+        # rejected BEFORE resolution (bundle_map only holds dir basenames,
+        # so lookup never joins an attacker-controlled path)
         if not name or "/" in name or "\\" in name or name.startswith("."):
             raise FileNotFoundError(name)
-        d = (self.scripts_dir / name).resolve()
-        if d.parent != self.scripts_dir.resolve():
+        from pixie_tpu.scripts import bundle_map
+
+        d = bundle_map(self.scripts_dir).get(name)
+        if d is None:
             raise FileNotFoundError(name)
         pxls = sorted(d.glob("*.pxl"))
         if not pxls:
