@@ -465,7 +465,18 @@ def main():
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
     ap.add_argument("--quick", action="store_true", help="small-but-real shapes")
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--check-regressions", nargs="?", const="", default=None,
+                    metavar="BENCH_JSON",
+                    help="guard mode (no benchmarks run): diff BENCH_JSON "
+                         "(default: the newest BENCH_r*.json) against the "
+                         "prior round and exit 1 on any "
+                         ">--regression-threshold rows_per_sec drop")
+    ap.add_argument("--regression-threshold", type=float, default=0.15,
+                    help="fractional drop that fails --check-regressions")
     args = ap.parse_args()
+    if args.check_regressions is not None:
+        sys.exit(check_regressions(args.check_regressions or None,
+                                   args.regression_threshold))
     if args.smoke:
         args.rows, args.sweep = 200_000, "200000"
         args.stream_rows, args.join_rows, args.dist_rows = 400_000, 200_000, 200_000
@@ -607,21 +618,20 @@ def main():
     print(json.dumps(result))
 
 
-def _regression_check(result, threshold=0.20):
-    """Compare per-config rows/sec against the newest BENCH_r*.json.
-
-    Round 3 shipped a 43% silent regression in config #4; every bench run now
-    self-audits.  Returns a list of {key, prior, now, drop_pct} entries for
-    any config/sweep point that dropped more than `threshold`."""
+def latest_bench_doc(exclude_path=None):
+    """(parsed_doc, path) of the newest BENCH_r*.json with a parsed configs
+    payload (rounds whose JSON line got truncated are skipped)."""
     import glob
     import re
 
     here = os.path.dirname(os.path.abspath(__file__))
-    prior = None
+    prior, prior_path = None, None
     best_round = -1
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
+            continue
+        if exclude_path and os.path.abspath(path) == os.path.abspath(exclude_path):
             continue
         rnd = int(m.group(1))
         if rnd <= best_round:
@@ -631,26 +641,30 @@ def _regression_check(result, threshold=0.20):
                 doc = json.load(f)
             parsed = doc.get("parsed", doc)
             if isinstance(parsed, dict) and "configs" in parsed:
-                prior, best_round = parsed, rnd
+                prior, prior_path, best_round = parsed, path, rnd
         except Exception:
             continue
-    if prior is None:
-        return []
+    return prior, prior_path
 
-    def points(doc):
-        """{key: (rows_per_sec, shape_rows)} — only shape-matched points
-        compare (a --smoke/--quick run must not 'regress' vs a full run)."""
-        out = {}
-        top_rows = doc.get("rows")
-        for k, v in (doc.get("configs") or {}).items():
-            if isinstance(v, dict) and "rows_per_sec" in v:
-                out[f"configs.{k}"] = (v["rows_per_sec"], v.get("rows", top_rows))
-        for k, v in (doc.get("sweep") or {}).items():
-            if isinstance(v, dict) and "rows_per_sec" in v:
-                out[f"sweep.{k}"] = (v["rows_per_sec"], int(k))
-        return out
 
-    old, new = points(prior), points(result)
+def bench_points(doc):
+    """{key: (rows_per_sec, shape_rows)} — only shape-matched points
+    compare (a --smoke/--quick run must not 'regress' vs a full run)."""
+    out = {}
+    top_rows = doc.get("rows")
+    for k, v in (doc.get("configs") or {}).items():
+        if isinstance(v, dict) and "rows_per_sec" in v:
+            out[f"configs.{k}"] = (v["rows_per_sec"], v.get("rows", top_rows))
+    for k, v in (doc.get("sweep") or {}).items():
+        if isinstance(v, dict) and "rows_per_sec" in v:
+            out[f"sweep.{k}"] = (v["rows_per_sec"], int(k))
+    return out
+
+
+def compare_bench(prior, current, threshold):
+    """[{key, prior, now, drop_pct}] for every shape-matched rows_per_sec
+    point that dropped more than `threshold` (a 0..1 fraction)."""
+    old, new = bench_points(prior), bench_points(current)
     regs = []
     for k, (prev, prev_rows) in old.items():
         now, now_rows = new.get(k, (None, None))
@@ -661,6 +675,59 @@ def _regression_check(result, threshold=0.20):
             regs.append({"key": k, "prior": prev, "now": now,
                          "drop_pct": round(drop * 100, 1)})
     return regs
+
+
+def _regression_check(result, threshold=0.20):
+    """Compare per-config rows/sec against the newest BENCH_r*.json.
+
+    Round 3 shipped a 43% silent regression in config #4; every bench run now
+    self-audits.  Returns a list of {key, prior, now, drop_pct} entries for
+    any config/sweep point that dropped more than `threshold`."""
+    prior, _path = latest_bench_doc()
+    if prior is None:
+        return []
+    return compare_bench(prior, result, threshold)
+
+
+def check_regressions(current_path=None, threshold=0.15):
+    """The CI guard (`bench.py --check-regressions [FILE]`): diff a bench
+    result JSON against the prior round's BENCH file and exit nonzero on any
+    >threshold drop in a configs.*/sweep.* rows_per_sec key — so an ingest
+    regression fails the PR instead of surfacing in the next round's verdict.
+
+    FILE may be a raw bench output line or a BENCH_r*.json wrapper; without
+    FILE the newest BENCH_r*.json is the "current" round and the guard diffs
+    it against the round before it.  Returns the process exit code."""
+    if current_path:
+        with open(current_path) as f:
+            doc = json.load(f)
+        current = doc.get("parsed", doc)
+        if not isinstance(current, dict) or "configs" not in current:
+            print(f"check-regressions: {current_path} has no parsed configs "
+                  "payload", file=sys.stderr)
+            return 2
+        prior, prior_path = latest_bench_doc(exclude_path=current_path)
+    else:
+        current, current_path = latest_bench_doc()
+        if current is None:
+            print("check-regressions: no BENCH_r*.json with a parsed payload",
+                  file=sys.stderr)
+            return 2
+        prior, prior_path = latest_bench_doc(exclude_path=current_path)
+    if prior is None:
+        print("check-regressions: no prior round to compare against; pass",
+              file=sys.stderr)
+        return 0
+    regs = compare_bench(prior, current, threshold)
+    base = os.path.basename(prior_path)
+    if regs:
+        for r in regs:
+            print(f"REGRESSION {r['key']}: {r['prior']} -> {r['now']} rows/s "
+                  f"(-{r['drop_pct']}% vs {base})", file=sys.stderr)
+        return 1
+    print(f"check-regressions: no >{round(threshold * 100)}% drops vs {base}",
+          file=sys.stderr)
+    return 0
 
 
 if __name__ == "__main__":

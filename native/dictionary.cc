@@ -7,6 +7,11 @@
 // value→code hashing over numpy's fixed-width UCS4 string grids, called via
 // ctypes with zero copies.
 //
+// The index is a flat open-addressing table (pow2 slots, linear probing)
+// over deque-stable key storage: one contiguous-array probe instead of
+// std::unordered_map's node hop, and the per-key hash is memoized so growth
+// rehashes without touching key bytes.
+//
 // Build: see pixie_tpu/native/build.py (g++ -O3 -shared -fPIC).
 //
 // Layout contract (matches numpy 'U' arrays): n rows, `stride` uint32 code
@@ -19,23 +24,76 @@
 #include <deque>
 #include <string>
 #include <string_view>
-#include <unordered_map>
 #include <vector>
 
 namespace {
 
+inline uint64_t hash_bytes(const char* p, size_t len) {
+  // 8-bytes-at-a-time multiply/xor mix (murmur-finalizer flavored).  UCS4
+  // rows are 4-byte-aligned multiples of 4 bytes, so the 8-wide loop covers
+  // nearly everything; the tail handles an odd trailing code point.
+  uint64_t h = 0x9e3779b97f4a7c15ull ^ (uint64_t)len;
+  while (len >= 8) {
+    uint64_t k;
+    std::memcpy(&k, p, 8);
+    h = (h ^ k) * 0xff51afd7ed558ccdull;
+    h ^= h >> 29;
+    p += 8;
+    len -= 8;
+  }
+  if (len) {
+    uint64_t k = 0;
+    std::memcpy(&k, p, len);
+    h = (h ^ k) * 0xc4ceb9fe1a85ec53ull;
+  }
+  h ^= h >> 32;
+  return h;
+}
+
 struct Dict {
   // Key storage must be pointer-stable across growth: deque never relocates
-  // existing elements.
+  // existing elements.  key_hash memoizes each key's hash for rehashing and
+  // as a cheap pre-compare on probe.
   std::deque<std::string> keys;  // raw UCS4 bytes, trimmed of trailing NULs
-  std::unordered_map<std::string_view, int32_t> index;
+  std::vector<uint64_t> key_hash;
+  std::vector<int32_t> slots;  // open addressing, -1 = empty
+  uint64_t mask;
+
+  Dict() : slots(64, -1), mask(63) {}
+
+  void grow() {
+    const size_t ns = slots.size() * 2;
+    std::vector<int32_t> fresh(ns, -1);
+    const uint64_t m = ns - 1;
+    for (size_t c = 0; c < keys.size(); ++c) {
+      uint64_t i = key_hash[c] & m;
+      while (fresh[i] != -1) i = (i + 1) & m;
+      fresh[i] = (int32_t)c;
+    }
+    slots.swap(fresh);
+    mask = m;
+  }
 
   int32_t insert(std::string_view raw) {
-    auto it = index.find(raw);
-    if (it != index.end()) return it->second;
+    const uint64_t h = hash_bytes(raw.data(), raw.size());
+    uint64_t i = h & mask;
+    for (;;) {
+      const int32_t c = slots[i];
+      if (c == -1) break;
+      if (key_hash[c] == h) {
+        const std::string& k = keys[c];
+        if (k.size() == raw.size() &&
+            std::memcmp(k.data(), raw.data(), raw.size()) == 0)
+          return c;
+      }
+      i = (i + 1) & mask;
+    }
+    const int32_t code = (int32_t)keys.size();
     keys.emplace_back(raw);
-    int32_t code = static_cast<int32_t>(keys.size()) - 1;
-    index.emplace(std::string_view(keys.back()), code);
+    key_hash.push_back(h);
+    slots[i] = code;
+    // grow at 3/4 load so probe chains stay short
+    if ((uint64_t)keys.size() * 4 >= slots.size() * 3) grow();
     return code;
   }
 };

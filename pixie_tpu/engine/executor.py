@@ -1375,16 +1375,43 @@ class PlanExecutor:
         op_ids = [head.id] + [op.id for op in chain]
 
         def gen():
-            # Fully async pipeline: dispatch every feed's step with the limit
-            # budgets carried as a DEVICE vector (no per-feed host sync), then
-            # exactly two round-trips — one packed pull of the row counts, one
-            # packed pull of the count-sliced outputs.  With a remote TPU each
-            # readback costs a fixed RTT, so per-feed pulls would dominate.
+            # Double-buffered readback pipeline: every feed's step dispatches
+            # async (limit budgets carried as a DEVICE vector, no host sync in
+            # the dispatch path); one feed behind, the previous wave's count
+            # lands (its async copy started at dispatch) and its count-sliced
+            # outputs start their D2H copy — so that transfer is in flight
+            # WHILE the current wave computes; two feeds behind, the sliced
+            # outputs materialize and yield.  With a remote TPU each readback
+            # pays a fixed RTT; here every wave's copy is issued under a later
+            # wave's compute, so the RTTs hide instead of serializing at the
+            # end (transfer.AsyncPull records the overlap split per wave).
+            from collections import deque
+
             with self._timed(label, op_ids) as rec, self._device_ctx(src):
                 has_limit = kern.has_limit
                 remaining = kern.init_limits()
-                feeds = []
+                computing: deque = deque()  # (outs, cnt): compute dispatched
+                pulling: deque = deque()    # (AsyncPull, rows): D2H in flight
                 feed_ns = []
+
+                def start_readback(overlapped: bool):
+                    outs, cnt = computing.popleft()
+                    c = int(np.asarray(cnt))
+                    pulling.append(
+                        (transfer.pull_async({k: v[:c] for k, v in outs.items()}),
+                         c))
+                    if overlapped:
+                        rec["pipelined_waves"] = rec.get("pipelined_waves", 0) + 1
+                        self.stats["pipelined_waves"] = (
+                            self.stats.get("pipelined_waves", 0) + 1)
+
+                def emit_ready():
+                    h, c = pulling.popleft()
+                    cols_np = h.wait()
+                    rec["rows_out"] += c
+                    rec["bytes_out"] += sum(v.nbytes for v in cols_np.values())
+                    return cols_np, c
+
                 for cols, n_valid in self._feed(
                         src, names, cap, backend=self._backend_for(src)):
                     tf0 = _time.perf_counter_ns()
@@ -1399,7 +1426,14 @@ class PlanExecutor:
                     if self.analyze:
                         jax.block_until_ready(outs)
                         feed_ns.append(_time.perf_counter_ns() - tf0)
-                    feeds.append((outs, cnt))
+                    if isinstance(cnt, jax.Array):
+                        # the count rides home under this wave's own compute
+                        cnt.copy_to_host_async()
+                    computing.append((outs, cnt))
+                    if len(computing) >= 2:
+                        start_readback(overlapped=True)
+                    while len(pulling) >= 2:
+                        yield emit_ready()
                 if self.analyze and feed_ns:
                     rec["feed_ns"] = feed_ns
                 if has_limit:
@@ -1410,18 +1444,10 @@ class PlanExecutor:
                     rec["limit_remaining"] = [
                         int(x) for x in np.asarray(jax.device_get(remaining))
                     ]
-                if not feeds:
-                    return
-                cnts = transfer.pull([c for _, c in feeds])
-                sliced = [
-                    {k: v[: int(c)] for k, v in outs.items()}
-                    for (outs, _), c in zip(feeds, cnts)
-                ]
-                pulled = transfer.pull(sliced)
-                for cols_np, c in zip(pulled, cnts):
-                    rec["rows_out"] += int(c)
-                    rec["bytes_out"] += sum(v.nbytes for v in cols_np.values())
-                    yield cols_np, int(c)
+                while computing:
+                    start_readback(overlapped=False)
+                while pulling:
+                    yield emit_ready()
 
         return out_dtypes, out_dicts, out_names, gen()
 
@@ -2323,6 +2349,81 @@ class PlanExecutor:
         self.stats["operators"] = self.op_stats
         self._emit_op_spans()
         return out
+
+    def run_agent_stream(self, agg_chunk_groups: int = 0):
+        """Execute an AGENT plan as a chunk stream: yields (channel, payload)
+        in wave order — one HostBatch per readback wave for rows channels
+        (each wave's D2H rode under a later wave's compute, engine.transfer),
+        per group-slice for agg_state channels (`agg_chunk_groups` > 0 caps
+        the slice), per bucket for partition sinks.  The networked agent
+        ships each yield as its own wire frame, so the broker's incremental
+        fold starts while this executor is still computing; run_agent is the
+        barrier shape of the same walk.
+
+        Chunks of one channel are yielded in order, but consumers must not
+        rely on it: the broker-side folds (PartialAggFold / HostBatchUnion)
+        are order-insensitive by construction.
+        """
+        from pixie_tpu.plan.plan import PartitionSinkOp
+
+        t0 = _time.perf_counter_ns()
+        for sink in self.plan.sinks():
+            if isinstance(sink, PartitionSinkOp):
+                from pixie_tpu.parallel.repartition import (
+                    mesh_partition_exchange,
+                    partition_ids,
+                    split_host_batch,
+                )
+
+                parent = self.plan.parents(sink)[0]
+                hb = self._materialize_parent(parent)
+                if (self.mesh is not None
+                        and self.mesh.size == sink.n_parts
+                        and hb.num_rows > 0):
+                    buckets = mesh_partition_exchange(
+                        hb, sink.keys, sink.n_parts, self.mesh)
+                    self.stats["mesh_shuffles"] = (
+                        self.stats.get("mesh_shuffles", 0) + 1)
+                else:
+                    part = partition_ids(hb, sink.keys, sink.n_parts)
+                    buckets = split_host_batch(hb, part, sink.n_parts)
+                for p, bucket in enumerate(buckets):
+                    yield f"{sink.prefix}{p}", bucket
+                continue
+            if not isinstance(sink, ResultSinkOp):
+                raise Internal(f"agent plan sink {sink.kind} is not a ResultSink")
+            parent = self.plan.parents(sink)[0]
+            if sink.payload == "agg_state":
+                if not (isinstance(parent, AggOp) and parent.partial):
+                    raise Internal("agg_state channel must be fed by a partial AggOp")
+                pb = self._partial_agg_batch(parent)
+                n = pb.num_groups
+                if agg_chunk_groups > 0 and n > agg_chunk_groups:
+                    from pixie_tpu.parallel.partial import slice_partial
+
+                    for a in range(0, n, agg_chunk_groups):
+                        idx = np.arange(a, min(a + agg_chunk_groups, n))
+                        yield sink.channel, slice_partial(pb, idx)
+                else:
+                    yield sink.channel, pb
+            else:
+                out_dtypes, out_dicts, out_names, gen = self._consume_chain(parent)
+                sent = False
+                for cols, _c in gen:
+                    sent = True
+                    yield sink.channel, HostBatch(
+                        dict(out_dtypes), dict(out_dicts),
+                        {name: cols[name] for name in out_names})
+                if not sent:
+                    # the channel contract is ≥1 payload: an empty scan still
+                    # ships one zero-row chunk carrying the dtypes/dicts
+                    yield sink.channel, HostBatch(
+                        dict(out_dtypes), dict(out_dicts),
+                        {name: np.empty(0, STORAGE_DTYPE[out_dtypes[name]])
+                         for name in out_names})
+        self.stats["wall_ns"] = _time.perf_counter_ns() - t0
+        self.stats["operators"] = self.op_stats
+        self._emit_op_spans()
 
     def _finalize_agg(self, op, keys, udas, state_np, seen_name, in_types=None,
                       val_dicts=None) -> HostBatch:

@@ -1,15 +1,27 @@
-"""Batched device→host readback.
+"""Batched + pipelined device→host readback.
 
 The readback analog of the reference's TransferResultChunk streaming
-(src/carnot/carnotpb/carnot.proto): all of a query's device outputs come back
-in ONE overlapped transfer wave.  Rationale: with a remote/tunneled TPU every
+(src/carnot/carnotpb/carnot.proto): a query's device outputs come back in
+overlapped transfer waves.  Rationale: with a remote/tunneled TPU every
 synchronous `np.asarray(jax_array)` pays a fixed round-trip (~160 ms measured);
 issuing `copy_to_host_async` on every leaf first overlaps the round-trips, so N
 pulls cost ~1 RTT instead of N (measured: 10 pulls 1650 ms → 95 ms).
 
+Two shapes:
+
+  * `pull(tree)` — the one-shot wave: async-copy every leaf, then block.
+  * `pull_async(tree)` → `AsyncPull.wait()` — the PIPELINED wave: the copy
+    starts now, the block happens later, so device compute dispatched in
+    between (the NEXT feed's execution) runs under the in-flight D2H.  The
+    executor's feed loop consumes waves one behind (double buffering).
+
 Each wave that actually touches device arrays is self-telemetered: its
 latency lands in the px_readback_wave_seconds histogram and, under an active
-trace, as a `readback_wave` span (see pixie_tpu.trace).
+trace, as a `readback_wave` span.  Pipelined waves additionally carry the
+overlap split: `overlap_ns` (wall time between copy start and wait —
+compute covered by the in-flight transfer) and `block_ns` (time the host
+actually stalled on the transfer).  overlap/(overlap+block) is the overlap
+efficiency px/self_query_latency reports.
 """
 from __future__ import annotations
 
@@ -20,6 +32,15 @@ import numpy as np
 
 #: wave latencies span ~1 ms (local CPU) to seconds (tunneled TPU)
 WAVE_BOUNDS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+
+def _observe_wave(t0_ns: int, dt_ns: int, n_dev: int, **attrs) -> None:
+    from pixie_tpu import metrics, trace
+
+    metrics.histogram_observe(
+        "px_readback_wave_seconds", dt_ns / 1e9, WAVE_BOUNDS,
+        help_="device->host readback wave latency (overlapped pull)")
+    trace.event_span("readback_wave", t0_ns, dt_ns, leaves=n_dev, **attrs)
 
 
 def pull(tree):
@@ -41,10 +62,54 @@ def pull(tree):
         for leaf in leaves
     ]
     dt_ns = time.time_ns() - t0
-    from pixie_tpu import metrics, trace
-
-    metrics.histogram_observe(
-        "px_readback_wave_seconds", dt_ns / 1e9, WAVE_BOUNDS,
-        help_="device->host readback wave latency (overlapped pull)")
-    trace.event_span("readback_wave", t0, dt_ns, leaves=n_dev)
+    _observe_wave(t0, dt_ns, n_dev)
     return jax.tree.unflatten(treedef, out)
+
+
+class AsyncPull:
+    """An in-flight D2H wave: copies started at construction, materialized at
+    wait().  Construct via pull_async()."""
+
+    __slots__ = ("_leaves", "_treedef", "_n_dev", "_t_submit", "_out", "_done")
+
+    def __init__(self, tree):
+        self._leaves, self._treedef = jax.tree.flatten(tree)
+        self._n_dev = 0
+        for leaf in self._leaves:
+            if isinstance(leaf, jax.Array):
+                leaf.copy_to_host_async()
+                self._n_dev += 1
+        self._t_submit = time.time_ns()
+        self._out = None
+        self._done = False
+
+    @property
+    def n_dev(self) -> int:
+        return self._n_dev
+
+    def wait(self):
+        """Block until the wave lands; → host pytree.  Idempotent."""
+        if self._done:
+            return self._out
+        t_wait = time.time_ns()
+        out = [
+            np.asarray(leaf) if isinstance(leaf, jax.Array) else leaf
+            for leaf in self._leaves
+        ]
+        t_done = time.time_ns()
+        if self._n_dev:
+            _observe_wave(
+                self._t_submit, t_done - self._t_submit, self._n_dev,
+                overlap_ns=t_wait - self._t_submit,
+                block_ns=t_done - t_wait,
+            )
+        self._out = jax.tree.unflatten(self._treedef, out)
+        self._leaves = ()  # release device refs
+        self._done = True
+        return self._out
+
+
+def pull_async(tree) -> AsyncPull:
+    """Start a D2H wave without blocking; `.wait()` materializes it.  Work
+    dispatched between the two overlaps the transfer (double buffering)."""
+    return AsyncPull(tree)

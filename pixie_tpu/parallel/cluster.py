@@ -27,26 +27,72 @@ from pixie_tpu.table.table import TableStore
 import numpy as np
 
 
+class HostBatchUnion:
+    """Incremental union of row batches from different producers: each add()
+    reconciles the chunk's dictionary code space into the running merged
+    dictionaries and stashes the translated columns; finish() pays one
+    concatenation.  This is the rows-channel analog of PartialAggFold —
+    the broker folds chunk frames as they arrive, so translation work hides
+    under the slowest producer's compute.
+
+    Row order follows fold order; distributed row-channel consumers are
+    order-insensitive (the merger re-aggregates / re-sorts as the plan
+    demands), matching the pre-streaming per-agent arrival order semantics.
+    """
+
+    __slots__ = ("count", "_first", "_dicts", "_parts")
+
+    def __init__(self):
+        self.count = 0
+        self._first: HostBatch | None = None
+        self._dicts: dict[str, Dictionary] = {}
+        self._parts: dict[str, list[np.ndarray]] = {}
+
+    def add(self, hb: HostBatch) -> None:
+        self.count += 1
+        if self._first is None:
+            self._first = hb
+            self._dicts = {n: Dictionary() for n in hb.dicts}
+            self._parts = {n: [] for n in hb.dtypes}
+        if hb.num_rows == 0:
+            return
+        self._fold_cols(hb)
+
+    def _fold_cols(self, hb: HostBatch) -> None:
+        from pixie_tpu.engine.eval import apply_lut_np
+
+        for name in self._first.dtypes:
+            if name in self._dicts:
+                lut = hb.dicts[name].translate_to(self._dicts[name], insert=True)
+                self._parts[name].append(apply_lut_np(lut, hb.cols[name]))
+            else:
+                self._parts[name].append(hb.cols[name])
+
+    def finish(self) -> HostBatch:
+        from pixie_tpu.status import InvalidArgument
+
+        first = self._first
+        if first is None:
+            raise InvalidArgument("HostBatchUnion.finish: no chunks folded")
+        if not any(self._parts.values()):
+            # every chunk was empty: fold the first chunk anyway so the
+            # result still carries its dtypes/dictionary values (the old
+            # batches[:1] behavior)
+            self._fold_cols(first)
+        cols = {
+            name: (parts[0] if len(parts) == 1 else np.concatenate(parts))
+            for name, parts in self._parts.items()
+        }
+        return HostBatch(dict(first.dtypes), dict(self._dicts), cols)
+
+
 def _union_host_batches(batches: list[HostBatch]) -> HostBatch:
     """Concatenate row batches from different agents, reconciling each
     dictionary code space into a fresh merged dictionary."""
-    batches = [b for b in batches if b.num_rows > 0] or batches[:1]
-    first = batches[0]
-    from pixie_tpu.engine.eval import apply_lut_np
-
-    cols, dicts = {}, {}
-    for name, dt in first.dtypes.items():
-        if name in first.dicts:
-            target = Dictionary()
-            dicts[name] = target
-            parts = []
-            for b in batches:
-                lut = b.dicts[name].translate_to(target, insert=True)
-                parts.append(apply_lut_np(lut, b.cols[name]))
-            cols[name] = np.concatenate(parts)
-        else:
-            cols[name] = np.concatenate([b.cols[name] for b in batches])
-    return HostBatch(dict(first.dtypes), dicts, cols)
+    u = HostBatchUnion()
+    for b in batches:
+        u.add(b)
+    return u.finish()
 
 
 class LocalCluster:

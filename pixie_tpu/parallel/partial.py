@@ -232,6 +232,55 @@ def merge_partials(
     return finalize_partial(agg, combine_partials(agg, partials, registry), registry)
 
 
+class PartialAggFold:
+    """Running merge of partial-agg chunks, folded AS THEY ARRIVE.
+
+    The streaming analog of merge_partials: the broker calls add() from each
+    producer frame handler, so combine work happens under the slowest agent's
+    compute instead of behind an all-agents barrier.  combine_partials
+    re-groups by key VALUES, so folds commute — chunk arrival order
+    (including cross-agent interleaving and out-of-order delivery) cannot
+    change the result.
+
+    Chunks stage in batches of FOLD_BATCH: each full batch combines on
+    arrival (the incremental work), and finish() pays ONE combine over the
+    staged results plus the finalize.  A per-chunk rolling accumulator would
+    re-group the whole accumulated key set on every add — O(chunks x
+    total_groups) for high-cardinality aggs; batching bounds the total work
+    at ~2x the barrier merge while keeping the overlap.
+
+    Thread model: callers serialize add() per channel (the broker holds
+    that channel's fold lock); finish() runs after all producers completed.
+    """
+
+    FOLD_BATCH = 8
+
+    __slots__ = ("agg", "registry", "count", "_staged", "_pending")
+
+    def __init__(self, agg: AggOp, registry):
+        self.agg = agg
+        self.registry = registry
+        self.count = 0
+        self._staged: list[PartialAggBatch] = []
+        self._pending: list[PartialAggBatch] = []
+
+    def add(self, pb: PartialAggBatch) -> None:
+        self.count += 1
+        self._pending.append(pb)
+        if len(self._pending) >= self.FOLD_BATCH:
+            self._staged.append(
+                combine_partials(self.agg, self._pending, self.registry))
+            self._pending = []
+
+    def finish(self) -> HostBatch:
+        parts = self._staged + self._pending
+        if not parts:
+            raise InvalidArgument("PartialAggFold.finish: no chunks folded")
+        acc = (parts[0] if len(parts) == 1
+               else combine_partials(self.agg, parts, self.registry))
+        return finalize_partial(self.agg, acc, self.registry)
+
+
 def _np_identity(dtype, op: str):
     d = np.dtype(dtype)
     if d.kind == "f":

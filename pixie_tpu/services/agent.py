@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Optional
 
-from pixie_tpu import trace
+from pixie_tpu import flags, trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.parallel.partial import PartialAggBatch
 from pixie_tpu.plan.plan import Plan
@@ -22,6 +22,18 @@ from pixie_tpu.services.transport import Connection, dial
 from pixie_tpu.table.table import TableStore
 
 DEFAULT_HEARTBEAT_S = 5.0  # reference manager/heartbeat.h:79
+
+flags.define_int(
+    "PL_STREAM_WINDOW", 4,
+    "max unacked in-flight result chunk frames per query (the agent blocks "
+    "further chunk sends until the broker acks; 0 = unbounded)")
+flags.define_int(
+    "PL_STREAM_AGG_CHUNK_GROUPS", 65536,
+    "split an agg_state channel payload into chunks of at most this many "
+    "groups so the broker's incremental fold starts early; 0 = one chunk")
+#: give up waiting for chunk acks after this long and degrade to unbounded
+#: streaming — a slow broker must throttle us, a broken one must not hang us
+ACK_STALL_S = 10.0
 
 
 class Agent:
@@ -71,6 +83,9 @@ class Agent:
         #: broker's registry knows the schema from the first handshake
         self.tracer = trace.Tracer(name)
         trace.ensure_table(self.store)
+        #: req_id → in-flight window semaphore; chunk_ack frames release it
+        self._windows: dict[str, threading.Semaphore] = {}
+        self._windows_lock = threading.Lock()
 
     # ---------------------------------------------------------------- lifecycle
     def start(self, timeout: float = 10.0) -> "Agent":
@@ -124,6 +139,15 @@ class Agent:
         if msg == "registered":
             self.asid = payload.get("asid")
             self._registered.set()
+        elif msg == "chunk_ack":
+            # broker consumed (folded) one of our chunk frames: open the
+            # in-flight window by one.  MUST stay on the read loop — it's a
+            # lone semaphore release, and a thread per ack would cost more
+            # than the fold it acknowledges.
+            with self._windows_lock:
+                sem = self._windows.get(payload.get("req_id", ""))
+            if sem is not None:
+                sem.release()
         elif msg == "reregister":
             self._register()
         elif msg == "execute":
@@ -173,6 +197,11 @@ class Agent:
         cm = (trace.root(self.tracer, "exec", ctx=tctx, agent=self.name,
                          req_id=req_id)
               if tctx else contextlib.nullcontext())
+        window = int(flags.get("PL_STREAM_WINDOW"))
+        sem = threading.Semaphore(window) if window > 0 else None
+        if sem is not None:
+            with self._windows_lock:
+                self._windows[req_id] = sem
         try:
             with cm:
                 plan = Plan.from_dict(meta["plan"])
@@ -182,17 +211,29 @@ class Agent:
                     route_scale=int(meta.get("route_scale", 1)),
                 )
                 t0 = time.perf_counter()
-                out = ex.run_agent()
-                for channel, payload in out.items():
+                # Chunk stream: each wave/slice ships as its own frame the
+                # moment the executor yields it, so the broker's incremental
+                # fold (and the NEXT wave's D2H) overlap this agent's compute
+                # instead of queueing behind a terminal result frame.
+                counts: dict[str, int] = {}
+                stalled = False
+                for channel, payload in ex.run_agent_stream(
+                        agg_chunk_groups=int(
+                            flags.get("PL_STREAM_AGG_CHUNK_GROUPS"))):
+                    if not stalled:
+                        stalled = not self._await_window(sem)
+                    seq = counts.get(channel, 0)
+                    counts[channel] = seq + 1
                     extra = {"msg": "chunk", "req_id": req_id,
-                             "channel": channel,
+                             "channel": channel, "seq": seq,
                              "agent": self.name, "qtoken": qtoken}
                     if isinstance(payload, PartialAggBatch):
-                        self.conn.send(wire.encode_partial_agg(payload, extra))
+                        frame = wire.encode_partial_agg(payload, extra)
                     elif isinstance(payload, HostBatch):
-                        self.conn.send(wire.encode_host_batch(payload, extra))
+                        frame = wire.encode_host_batch(payload, extra)
                     else:
                         raise TypeError(f"unexpected payload {type(payload)}")
+                    self.conn.send(frame)
                 stats = dict(ex.stats)
                 stats["exec_s"] = time.perf_counter() - t0
             # spans persist BEFORE the ack: when exec_done lands at the
@@ -203,6 +244,10 @@ class Agent:
             self.conn.send(wire.encode_json({
                 "msg": "exec_done", "req_id": req_id, "agent": self.name,
                 "qtoken": qtoken, "stats": _jsonable(stats),
+                # per-channel chunk counts: the broker verifies its folds saw
+                # every frame (a dropped chunk must fail loudly, not merge a
+                # silently-partial answer)
+                "chunks": counts,
             }))
         except Exception as e:
             self._flush_trace()
@@ -210,6 +255,35 @@ class Agent:
                 "msg": "exec_error", "req_id": req_id, "agent": self.name,
                 "qtoken": qtoken, "error": str(e),
             }))
+        finally:
+            if sem is not None:
+                with self._windows_lock:
+                    self._windows.pop(req_id, None)
+
+    def _await_window(self, sem: Optional[threading.Semaphore]) -> bool:
+        """Block until the in-flight chunk window opens; False on stall.
+        After one stall the caller stops waiting for the rest of the query
+        (degraded to unbounded, counted): TCP still backpressures a
+        slow-but-alive broker, and a broker that stopped acking — typically
+        because this query already died there — must not wedge this
+        executor thread for stall-budget × remaining-chunks."""
+        if sem is None:
+            return True
+        deadline = time.monotonic() + ACK_STALL_S
+        while not self._stop.is_set():
+            if sem.acquire(timeout=0.2):
+                return True
+            if self.conn is None or self.conn.closed:
+                return False
+            if time.monotonic() >= deadline:
+                from pixie_tpu import metrics as _metrics
+
+                _metrics.counter_inc(
+                    "px_agent_chunk_ack_stalls_total",
+                    help_="chunk sends that proceeded without an ack "
+                          "(broker stopped acking within the stall budget)")
+                return False
+        return False
 
     def _write_shipped_spans(self, rows: list) -> None:
         try:

@@ -23,7 +23,6 @@ from pixie_tpu import trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.parallel.distributed import DistributedPlanner
-from pixie_tpu.parallel.partial import PartialAggBatch, merge_partials
 from pixie_tpu.services import wire
 from pixie_tpu.services.kvstore import KVStore
 from pixie_tpu.services.registry import AgentRegistry
@@ -37,6 +36,10 @@ DEFAULT_QUERY_TIMEOUT_S = 60.0
 #: broker end-to-end query latency buckets (seconds)
 QUERY_LATENCY_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
                         10.0, 30.0, 60.0)
+
+#: incremental_fold spans recorded per query (folds beyond the cap still
+#: merge and count; only their span detail is dropped)
+MAX_FOLD_EVENT_SPANS = 256
 
 
 class _QueryCtx:
@@ -57,6 +60,83 @@ class _QueryCtx:
         #: broker injects a per-query auth token into GRPCSinks and the
         #: result-sink server validates it, carnotpb/carnot.proto:30-96)
         self.token = secrets.token_urlsafe(12)
+        # ---- streaming incremental merge (set up by configure_folds) ----
+        #: channel id → PartialAggFold | HostBatchUnion: chunk frames fold
+        #: into these AS THEY ARRIVE (reader threads), so merge work hides
+        #: under the slowest agent's compute; channels without a fold (join
+        #: bucket channels) accumulate in `payloads` as before
+        self.folds: dict[str, object] = {}
+        #: per-channel locks: fold.add serializes across agent reader
+        #: threads (the accumulators are not thread-safe), but folds on
+        #: DISTINCT channels share no state — a heavy agg combine on one
+        #: channel must not stall another channel's folds and acks
+        self.fold_locks: dict[str, threading.Lock] = {}
+        #: channel → chunks folded / expected (expected accumulates from the
+        #: per-agent counts on exec_done frames)
+        self.folded_chunks: dict[str, int] = {}
+        self.expected_chunks: dict[str, int] = {}
+        #: (start_unix_ns, duration_ns, channel, agent) per fold, emitted as
+        #: incremental_fold spans at merge time (the reader threads hold no
+        #: trace context); capped — first_fold_ns/last_terminal_ns carry the
+        #: overlap evidence, span detail beyond the cap adds nothing
+        self.fold_events: list[tuple] = []
+        self.first_fold_ns: Optional[int] = None
+        self.last_terminal_ns: Optional[int] = None
+
+    def configure_folds(self, dp, registry) -> None:
+        """Arm one incremental accumulator per merge-input channel.  Must run
+        before the first `execute` frame is sent (chunks race the dispatch
+        loop); join-stage bucket channels keep list accumulation — the stage
+        runner consumes whole per-partition lists at merge time."""
+        from pixie_tpu.parallel.cluster import HostBatchUnion
+        from pixie_tpu.parallel.partial import PartialAggFold
+        from pixie_tpu.parallel.repartition import bucket_channels
+
+        consumed = bucket_channels(dp)
+        for cid, ch in dp.channels.items():
+            if cid in consumed:
+                continue
+            if ch.kind == "agg_state":
+                self.folds[cid] = PartialAggFold(ch.agg, registry)
+            else:
+                self.folds[cid] = HostBatchUnion()
+            self.fold_locks[cid] = threading.Lock()
+
+    def fold_chunk(self, meta: dict, payload) -> None:
+        """Fold one producer chunk frame; called from connection reader
+        threads.  A malformed chunk fails the QUERY (error + done), never
+        the reader thread."""
+        import time as _time
+
+        cid = meta["channel"]
+        fold = self.folds.get(cid)
+        if fold is None:
+            self.payloads.setdefault(cid, []).append(payload)
+            return
+        from pixie_tpu.parallel.cluster import HostBatchUnion
+        from pixie_tpu.parallel.partial import PartialAggBatch, PartialAggFold
+
+        t0 = _time.time_ns()
+        try:
+            with self.fold_locks[cid]:
+                if isinstance(fold, PartialAggFold):
+                    if not isinstance(payload, PartialAggBatch):
+                        raise TypeError(
+                            f"channel {cid}: expected agg_state payloads")
+                elif isinstance(fold, HostBatchUnion):
+                    if not isinstance(payload, HostBatch):
+                        raise TypeError(f"channel {cid}: expected row payloads")
+                fold.add(payload)
+                self.folded_chunks[cid] = self.folded_chunks.get(cid, 0) + 1
+        except Exception as e:
+            self.error = f"chunk fold failed on channel {cid}: {e}"
+            self.done.set()
+            return
+        if self.first_fold_ns is None:
+            self.first_fold_ns = t0
+        if len(self.fold_events) < MAX_FOLD_EVENT_SPANS:
+            self.fold_events.append(
+                (t0, _time.time_ns() - t0, cid, meta.get("agent")))
 
 
 class Broker:
@@ -388,9 +468,20 @@ class Broker:
 
     def _handle_chunk(self, meta: dict, payload):
         ctx = self._ctx(meta)
-        if ctx is None:
-            return
-        ctx.payloads.setdefault(meta["channel"], []).append(payload)
+        if ctx is not None:
+            ctx.fold_chunk(meta, payload)
+        # Open the producer's in-flight window (its backpressure gate): the
+        # ack means this chunk's fold work is DONE, so a slow merge throttles
+        # the agents instead of queueing unbounded frames.  Acked even when
+        # the query is already dead (ctx None / stale token): acks are pure
+        # flow control, and a producer still draining a doomed stream must
+        # not stall on a window nobody will ever open.
+        conn = self._agent_conns.get(meta.get("agent", ""))
+        if conn is not None and not conn.closed:
+            conn.send(wire.encode_json({
+                "msg": "chunk_ack", "req_id": meta.get("req_id"),
+                "channel": meta["channel"], "seq": meta.get("seq"),
+            }))
 
     def _finish_dispatch_span(self, ctx: _QueryCtx, agent,
                               error: Optional[str] = None) -> None:
@@ -401,10 +492,15 @@ class Broker:
             self.tracer.finish(sp)
 
     def _handle_exec_done(self, meta: dict):
+        import time as _time
+
         ctx = self._ctx(meta)
         if ctx is None:
             return
         ctx.agent_stats[meta["agent"]] = meta.get("stats", {})
+        ctx.last_terminal_ns = _time.time_ns()
+        for cid, n in (meta.get("chunks") or {}).items():
+            ctx.expected_chunks[cid] = ctx.expected_chunks.get(cid, 0) + int(n)
         self._finish_dispatch_span(ctx, meta["agent"])
         ctx.pending_agents.discard(meta["agent"])
         if not ctx.pending_agents:
@@ -575,7 +671,6 @@ class Broker:
         funcs=None,
     ) -> tuple[dict[str, QueryResult], dict]:
         from pixie_tpu.compiler import compile_pxl, compile_pxl_funcs
-        from pixie_tpu.parallel.cluster import _union_host_batches
         from pixie_tpu.status import Internal, Unavailable
 
         if self.elector is not None and not self.elector.is_leader():
@@ -611,10 +706,14 @@ class Broker:
         with trace.span("plan_split"):
             dp = DistributedPlanner(spec).plan(q.plan)
 
+        reg = self.udf_registry
+        if reg is None:
+            from pixie_tpu.udf import registry as reg
         with self._qlock:
             self._req_counter += 1
             req_id = f"q{self._req_counter}"
             ctx = _QueryCtx(set(dp.agent_plans), set(dp.channels))
+            ctx.configure_folds(dp, reg)
             self._queries[req_id] = ctx
         try:
             for agent_name, plan in dp.agent_plans.items():
@@ -647,15 +746,19 @@ class Broker:
                 raise Unavailable(ctx.error)
 
             with trace.span("merge"):
-                reg = self.udf_registry
-                if reg is None:
-                    from pixie_tpu.udf import registry as reg
                 from pixie_tpu.parallel.repartition import (
                     bucket_channels,
                     run_join_stages,
                     stage_output_inputs,
                 )
 
+                # chunk folds ran on the reader threads (no trace context
+                # there): emit them as spans now, under this query's root —
+                # their start times preceding last_terminal_ns is the direct
+                # evidence that merge work overlapped agent compute
+                for t0_ns, dur_ns, cid, agent in ctx.fold_events:
+                    trace.event_span("incremental_fold", t0_ns, dur_ns,
+                                     channel=cid, agent=agent)
                 if dp.join_stages:
                     # repartitioned joins run partition-parallel on the merger
                     # (the Kelvin role); bucket channels are consumed here, with
@@ -667,19 +770,25 @@ class Broker:
                 for cid, ch in dp.channels.items():
                     if cid in consumed:
                         continue
-                    got = ctx.payloads.get(cid, [])
-                    if not got:
+                    fold = ctx.folds.get(cid)
+                    if fold is None or fold.count == 0:
                         raise Internal(f"channel {cid} received no payloads")
-                    if ch.kind == "agg_state":
-                        if not all(isinstance(p, PartialAggBatch) for p in got):
-                            raise Internal(f"channel {cid}: expected agg_state payloads")
-                        with trace.span("partial_merge", channel=cid,
-                                        producers=len(got)):
-                            inputs[cid] = merge_partials(ch.agg, got, reg)
-                    else:
-                        if not all(isinstance(p, HostBatch) for p in got):
-                            raise Internal(f"channel {cid}: expected row payloads")
-                        inputs[cid] = _union_host_batches(got)
+                    # every chunk an agent SENT must have folded: a dropped
+                    # frame means a silently-partial answer, so fail instead
+                    if cid in ctx.expected_chunks and (
+                            ctx.folded_chunks.get(cid, 0)
+                            != ctx.expected_chunks[cid]):
+                        raise Internal(
+                            f"channel {cid}: folded "
+                            f"{ctx.folded_chunks.get(cid, 0)} of "
+                            f"{ctx.expected_chunks[cid]} chunk frames")
+                    # the running fold already combined every chunk on
+                    # arrival; finish() only finalizes (agg) or pays the one
+                    # concatenation (rows)
+                    with trace.span("merge_finish", channel=cid,
+                                    kind=ch.kind, chunks=fold.count,
+                                    incremental=True):
+                        inputs[cid] = fold.finish()
                 inputs.update(stage_output_inputs(dp, ctx.payloads))
 
                 from pixie_tpu.udf.udtf import UDTFContext
@@ -702,6 +811,18 @@ class Broker:
                 for r in results.values():
                     restamp_result(r, q.plan, sstore, reg)
                 stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+                #: streaming-merge observability: merge_overlapped=True means
+                #: the first chunk folded BEFORE the last agent's terminal
+                #: frame — merge cost hid under the slowest agent's compute
+                stats["stream"] = {
+                    "chunks_folded": sum(ctx.folded_chunks.values()),
+                    "first_fold_unix_ns": ctx.first_fold_ns,
+                    "last_terminal_unix_ns": ctx.last_terminal_ns,
+                    "merge_overlapped": bool(
+                        ctx.first_fold_ns is not None
+                        and ctx.last_terminal_ns is not None
+                        and ctx.first_fold_ns < ctx.last_terminal_ns),
+                }
                 if sink_map is not None:
                     stats["sink_map"] = sink_map
                     stats["merger"]["operators"] = ex.op_stats

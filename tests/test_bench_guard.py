@@ -1,0 +1,72 @@
+"""Bench regression guard (`bench.py --check-regressions`): the tier-1 gate
+that fails a PR on >15% rows_per_sec drops instead of letting them surface
+in the next round's verdict (the r05 ingest regression path)."""
+import json
+
+import bench
+
+
+def _doc(ingest=22_000_000, join=125_000_000, rows=64_000_000):
+    return {
+        "rows": rows,
+        "sweep": {"1000000": {"rows_per_sec": 50_000_000}},
+        "configs": {
+            "ingest_microbench": {"rows_per_sec": ingest},
+            "3_flow_join": {"rows_per_sec": join, "rows": 16_000_000},
+        },
+    }
+
+
+def test_compare_flags_drops_over_threshold():
+    prior, now = _doc(), _doc(ingest=16_700_000)  # the r05 regression shape
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert [r["key"] for r in regs] == ["configs.ingest_microbench"]
+    assert regs[0]["prior"] == 22_000_000
+    assert regs[0]["drop_pct"] > 15
+
+
+def test_compare_tolerates_small_drops_and_gains():
+    prior = _doc()
+    now = _doc(ingest=int(22_000_000 * 0.9), join=200_000_000)  # -10% / +60%
+    assert bench.compare_bench(prior, now, threshold=0.15) == []
+
+
+def test_compare_only_shape_matched_points():
+    """A --smoke/--quick run (different shapes) must not 'regress' vs a full
+    run: mismatched rows are skipped entirely."""
+    prior = _doc()
+    now = _doc(join=1_000, rows=64_000_000)
+    now["configs"]["3_flow_join"]["rows"] = 200_000  # smoke-sized join
+    now["sweep"] = {"200000": {"rows_per_sec": 1_000}}  # different sweep point
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert regs == []
+
+
+def test_check_regressions_cli_paths(tmp_path, capsys):
+    """File mode: a doc with a dropped config fails (exit 1) against the
+    repo's prior BENCH round; the prior round's own numbers pass (exit 0)."""
+    prior, prior_path = bench.latest_bench_doc()
+    assert prior is not None and "configs" in prior
+
+    same = tmp_path / "same.json"
+    same.write_text(json.dumps(prior))
+    assert bench.check_regressions(str(same), threshold=0.15) == 0
+
+    import copy
+
+    bad = copy.deepcopy(prior)
+    key = next(k for k, v in bad["configs"].items()
+               if isinstance(v, dict) and "rows_per_sec" in v)
+    bad["configs"][key]["rows_per_sec"] = int(
+        bad["configs"][key]["rows_per_sec"] * 0.5)
+    badf = tmp_path / "bad.json"
+    badf.write_text(json.dumps({"parsed": bad}))  # wrapper shape accepted too
+    assert bench.check_regressions(str(badf), threshold=0.15) == 1
+    err = capsys.readouterr().err
+    assert "REGRESSION" in err and key in err
+
+
+def test_check_regressions_rejects_unparsed(tmp_path):
+    f = tmp_path / "empty.json"
+    f.write_text(json.dumps({"parsed": None, "tail": "truncated..."}))
+    assert bench.check_regressions(str(f), threshold=0.15) == 2

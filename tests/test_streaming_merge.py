@@ -1,0 +1,504 @@
+"""Streaming result pipeline: per-wave chunk frames, the broker's
+incremental fold, pipelined D2H readback, and the fault paths of each.
+
+Reference analog: TransferResultChunk streaming + QueryResultForwarder
+producer watchdogs (carnotpb/carnot.proto, query_result_forwarder.go).
+"""
+import random
+import time
+
+import numpy as np
+import pytest
+
+from pixie_tpu import flags, trace
+from pixie_tpu.engine.executor import HostBatch, PlanExecutor
+from pixie_tpu.parallel.cluster import HostBatchUnion
+from pixie_tpu.parallel.partial import PartialAggBatch, PartialAggFold
+from pixie_tpu.plan.plan import Plan
+from pixie_tpu.services import wire
+from pixie_tpu.services.agent import Agent
+from pixie_tpu.services.broker import Broker
+from pixie_tpu.services.client import Client, QueryError
+from pixie_tpu.table import TableStore
+from pixie_tpu.table.dictionary import Dictionary
+from pixie_tpu.types import DataType as DT, Relation
+
+
+def _mkstore(seed, n=20_000):
+    rng = np.random.default_rng(seed)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING),
+        ("latency", DT.FLOAT64), ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=4096)
+    t.write({
+        "time_": np.arange(n, dtype=np.int64) * 1000,
+        "service": rng.choice(["cart", "auth", "web"], n).tolist(),
+        "latency": rng.exponential(20.0, n),
+        "status": rng.choice([200, 500], n),
+    })
+    return ts
+
+
+AGG_SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(cnt=('latency', px.count), m=('latency', px.mean))
+px.display(df, 'out')
+"""
+
+ROWS_SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 500]
+df = df[['service', 'latency']]
+px.display(df, 'out')
+"""
+
+
+def _count_500(ts: TableStore) -> int:
+    t = ts.table("http_events")
+    return sum(
+        int((rb.columns["status"][: rb.num_valid] == 500).sum())
+        for rb, _rid, _gen in t.cursor()
+    )
+
+
+def _all_span_rows(stores: dict) -> list[dict]:
+    rows = []
+    for st in stores.values():
+        if not st.has(trace.SPANS_TABLE):
+            continue
+        t = st.table(trace.SPANS_TABLE)
+        for rb, _rid, _gen in t.cursor():
+            n = rb.num_valid
+            cols = {}
+            for c in t.relation:
+                arr = rb.columns[c.name][:n]
+                cols[c.name] = (t.dictionaries[c.name].decode(arr)
+                                if c.name in t.dictionaries else arr.tolist())
+            rows.extend({k: cols[k][i] for k in cols} for i in range(n))
+    return rows
+
+
+@pytest.fixture
+def cluster():
+    broker = Broker(hb_expiry_s=1.0, query_timeout_s=30.0).start()
+    stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
+    agents = [
+        Agent(name, "127.0.0.1", broker.port, store=st, heartbeat_s=0.2).start()
+        for name, st in stores.items()
+    ]
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    yield broker, stores, agents, client
+    client.close()
+    for a in agents:
+        a.stop()
+    broker.stop()
+
+
+@pytest.fixture
+def tiny_agg_chunks():
+    """Force one agg_state chunk frame per group so every query streams
+    multiple chunks through the ack window."""
+    flags.set_for_testing("PL_STREAM_AGG_CHUNK_GROUPS", 1)
+    yield
+    flags.set_for_testing("PL_STREAM_AGG_CHUNK_GROUPS", 65536)
+
+
+# ------------------------------------------------- incremental merge overlap
+
+
+def test_merge_begins_before_last_terminal_frame(cluster, tiny_agg_chunks):
+    """The acceptance check: fold work starts BEFORE the last agent's
+    exec_done, proven by the broker's stream stats and by incremental_fold
+    span start times preceding the terminal timestamp."""
+    broker, stores, _agents, _client = cluster
+    results, stats = broker.execute_script(AGG_SCRIPT)
+    st = stats["stream"]
+    # 3 services per agent, chunked one group per frame → ≥6 folds
+    assert st["chunks_folded"] >= 6
+    assert st["first_fold_unix_ns"] is not None
+    assert st["last_terminal_unix_ns"] is not None
+    assert st["first_fold_unix_ns"] < st["last_terminal_unix_ns"]
+    assert st["merge_overlapped"] is True
+    # result is still exact
+    got = results["out"].to_pandas().sort_values("service")
+    assert got["cnt"].sum() == 40_000
+
+    # span ordering: incremental_fold spans landed in an agent's spans table
+    # with start times before the last terminal frame
+    deadline = time.monotonic() + 5
+    folds = []
+    while time.monotonic() < deadline and not folds:
+        folds = [r["time_"] for r in _all_span_rows(stores)
+                 if r["name"] == "incremental_fold"]
+        if not folds:
+            time.sleep(0.05)
+    assert folds, "no incremental_fold spans recorded"
+    assert min(folds) < st["last_terminal_unix_ns"]
+
+
+def test_rows_channel_streams_and_matches(cluster):
+    """Rows channels stream per-wave chunks; the incremental union matches
+    the barrier union's answer."""
+    broker, stores, _agents, _client = cluster
+    results, stats = broker.execute_script(ROWS_SCRIPT)
+    assert stats["stream"]["chunks_folded"] >= 2  # ≥1 chunk per agent
+    got = results["out"].to_pandas()
+    want = sum(_count_500(ts) for ts in stores.values())
+    assert len(got) == want
+
+
+def test_chunked_query_matches_unchunked(cluster, tiny_agg_chunks):
+    broker, _stores, _agents, _client = cluster
+    r1, _ = broker.execute_script(AGG_SCRIPT)
+    flags.set_for_testing("PL_STREAM_AGG_CHUNK_GROUPS", 0)  # one fat chunk
+    r2, _ = broker.execute_script(AGG_SCRIPT)
+    a = r1["out"].to_pandas().sort_values("service").reset_index(drop=True)
+    b = r2["out"].to_pandas().sort_values("service").reset_index(drop=True)
+    assert list(a["service"]) == list(b["service"])
+    assert list(a["cnt"]) == list(b["cnt"])
+    np.testing.assert_allclose(a["m"], b["m"])
+
+
+# --------------------------------------------------- out-of-order delivery
+
+
+def _agent_chunks(broker, stores, script, agg_chunk_groups=1):
+    """Run each agent's plan fragment locally and capture its chunk stream
+    (channel, payload) — the exact frames the networked agent would send."""
+    from pixie_tpu.compiler import compile_pxl
+    from pixie_tpu.parallel.distributed import DistributedPlanner
+
+    q = compile_pxl(script, broker.registry.combined_schemas())
+    dp = DistributedPlanner(broker.registry.cluster_spec()).plan(q.plan)
+    chunks = {}
+    for name, plan in dp.agent_plans.items():
+        ex = PlanExecutor(plan, stores[name], None)
+        chunks[name] = list(ex.run_agent_stream(agg_chunk_groups=agg_chunk_groups))
+    return dp, chunks
+
+
+def test_out_of_order_chunks_fold_to_same_answer(cluster):
+    """Chunk arrival order (cross-agent interleaving, full shuffles) cannot
+    change the folded result: PartialAggFold combines by key VALUES."""
+    broker, stores, _agents, _client = cluster
+    dp, chunks = _agent_chunks(broker, stores, AGG_SCRIPT)
+    (cid, ch), = [(c, ch) for c, ch in dp.channels.items()
+                  if ch.kind == "agg_state"]
+    payloads = [p for name in chunks for c, p in chunks[name] if c == cid]
+    assert len(payloads) >= 6
+    assert all(isinstance(p, PartialAggBatch) for p in payloads)
+
+    from pixie_tpu.udf import registry as reg
+
+    def folded(order):
+        fold = PartialAggFold(ch.agg, reg)
+        for p in order:
+            fold.add(p)
+        hb = fold.finish()
+        import pandas as pd
+
+        svc = hb.dicts["service"].values()
+        return (
+            pd.DataFrame({
+                "service": [svc[c] for c in hb.cols["service"]],
+                "cnt": hb.cols["cnt"], "m": hb.cols["m"],
+            })
+            .sort_values("service").reset_index(drop=True)
+        )
+
+    base = folded(payloads)
+    for seed in (3, 7, 11):
+        shuf = list(payloads)
+        random.Random(seed).shuffle(shuf)
+        out = folded(shuf)
+        assert list(out["service"]) == list(base["service"])
+        assert list(out["cnt"]) == list(base["cnt"])
+        np.testing.assert_allclose(out["m"], base["m"])
+
+
+def test_out_of_order_rows_union_same_multiset(cluster):
+    broker, stores, _agents, _client = cluster
+    dp, chunks = _agent_chunks(broker, stores, ROWS_SCRIPT)
+    (cid,) = [c for c, ch in dp.channels.items() if ch.kind != "agg_state"]
+    payloads = [p for name in chunks for c, p in chunks[name] if c == cid]
+    assert all(isinstance(p, HostBatch) for p in payloads)
+
+    def rows(order):
+        u = HostBatchUnion()
+        for p in order:
+            u.add(p)
+        hb = u.finish()
+        svc = hb.dicts["service"].values()
+        return sorted(
+            (svc[c], round(float(v), 9))
+            for c, v in zip(hb.cols["service"], hb.cols["latency"])
+        )
+
+    base = rows(payloads)
+    shuf = list(payloads)
+    random.Random(5).shuffle(shuf)
+    assert rows(shuf) == base
+
+
+# ------------------------------------------------------------- fault paths
+
+
+class _DyingAgent(Agent):
+    """Sends its first chunk frame, then drops the connection — the
+    mid-stream producer death the watchdog must surface cleanly."""
+
+    def _execute(self, meta):
+        plan = Plan.from_dict(meta["plan"])
+        ex = PlanExecutor(plan, self.store, self.registry)
+        for channel, payload in ex.run_agent_stream(agg_chunk_groups=1):
+            extra = {"msg": "chunk", "req_id": meta.get("req_id"),
+                     "channel": channel, "seq": 0, "agent": self.name,
+                     "qtoken": meta.get("qtoken")}
+            if isinstance(payload, PartialAggBatch):
+                self.conn.send(wire.encode_partial_agg(payload, extra))
+            else:
+                self.conn.send(wire.encode_host_batch(payload, extra))
+            break
+        self.conn.close()  # no exec_done, no exec_error: just gone
+
+
+class _MiscountingAgent(Agent):
+    """Streams normally but reports one more chunk than it sent: the broker
+    must refuse to merge a silently-short stream."""
+
+    def _execute(self, meta):
+        plan = Plan.from_dict(meta["plan"])
+        ex = PlanExecutor(plan, self.store, self.registry)
+        counts = {}
+        for channel, payload in ex.run_agent_stream(agg_chunk_groups=0):
+            seq = counts.get(channel, 0)
+            counts[channel] = seq + 1
+            extra = {"msg": "chunk", "req_id": meta.get("req_id"),
+                     "channel": channel, "seq": seq, "agent": self.name,
+                     "qtoken": meta.get("qtoken")}
+            if isinstance(payload, PartialAggBatch):
+                self.conn.send(wire.encode_partial_agg(payload, extra))
+            else:
+                self.conn.send(wire.encode_host_batch(payload, extra))
+        lied = {c: n + 1 for c, n in counts.items()}
+        self.conn.send(wire.encode_json({
+            "msg": "exec_done", "req_id": meta.get("req_id"),
+            "agent": self.name, "qtoken": meta.get("qtoken"),
+            "stats": {}, "chunks": lied,
+        }))
+
+
+def test_agent_dying_mid_stream_fails_query_cleanly():
+    broker = Broker(hb_expiry_s=1.0, query_timeout_s=10.0).start()
+    stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
+    a1 = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
+               heartbeat_s=0.2).start()
+    a2 = _DyingAgent("pem2", "127.0.0.1", broker.port, store=stores["pem2"],
+                     heartbeat_s=0.2).start()
+    client = Client("127.0.0.1", broker.port, timeout_s=15.0)
+    try:
+        with pytest.raises(QueryError) as ei:
+            client.execute_script(AGG_SCRIPT)
+        assert "pem2" in str(ei.value)
+        assert "disconnected" in str(ei.value)
+        # the dead query left no residue: no partial rows are served later.
+        # wait for expiry, then the replanned query (pem1 only) is exact.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if {a.name for a in broker.registry.live_agents()} == {"pem1"}:
+                break
+            time.sleep(0.05)
+        res = client.execute_script(AGG_SCRIPT)["out"]
+        assert res.to_pandas()["cnt"].sum() == 20_000  # pem1's rows ONLY
+    finally:
+        client.close()
+        a1.stop()
+        a2.stop()
+        broker.stop()
+
+
+def test_chunk_count_mismatch_fails_query():
+    broker = Broker(hb_expiry_s=1.0, query_timeout_s=10.0).start()
+    stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
+    a1 = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
+               heartbeat_s=0.2).start()
+    a2 = _MiscountingAgent("pem2", "127.0.0.1", broker.port,
+                           store=stores["pem2"], heartbeat_s=0.2).start()
+    client = Client("127.0.0.1", broker.port, timeout_s=15.0)
+    try:
+        with pytest.raises(QueryError) as ei:
+            client.execute_script(AGG_SCRIPT)
+        assert "folded" in str(ei.value)
+    finally:
+        client.close()
+        a1.stop()
+        a2.stop()
+        broker.stop()
+
+
+# ------------------------------------------------------- pipelined readback
+
+
+def test_readback_overlaps_next_feed(monkeypatch):
+    """With multiple feeds, each wave's D2H copy is issued under a later
+    wave's compute: the executor counts pipelined waves and the readback
+    spans carry the overlap split."""
+    from pixie_tpu.engine import executor as exmod
+    from pixie_tpu.compiler import compile_pxl
+
+    monkeypatch.setattr(exmod, "FEED_ROWS", 4096)
+    ts = _mkstore(3, n=20_000)  # batch_rows=4096 → 5 feeds
+    schemas = {"http_events": ts.table("http_events").relation}
+    q = compile_pxl(ROWS_SCRIPT, schemas)
+    tracer = trace.Tracer("test")
+    with trace.root(tracer, "q"):
+        ex = PlanExecutor(q.plan, ts, None)
+        res = ex.run()
+    assert ex.stats.get("pipelined_waves", 0) >= 1
+    spans = tracer.drain()
+    waves = [s for s in spans if s.name == "readback_wave"
+             and "overlap_ns" in (s.attributes or {})]
+    assert waves, "no pipelined readback_wave spans with overlap split"
+    for s in waves:
+        assert s.attributes["overlap_ns"] >= 0
+        assert s.attributes["block_ns"] >= 0
+    # and the answer is right
+    assert res["out"].num_rows == _count_500(ts)
+
+
+def test_async_pull_matches_sync_pull():
+    from pixie_tpu.engine import transfer
+
+    tree = {"a": np.arange(10_000, dtype=np.int64),
+            "b": np.linspace(0, 1, 10_000)}
+    import jax.numpy as jnp
+
+    dev = {k: jnp.asarray(v) for k, v in tree.items()}
+    h = transfer.pull_async(dev)
+    out = h.wait()
+    assert h.wait() is out  # idempotent
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(out[k]), tree[k])
+    sync = transfer.pull(dev)
+    for k in tree:
+        np.testing.assert_array_equal(np.asarray(sync[k]), tree[k])
+
+
+# ------------------------------------------------------------ wire payloads
+
+
+def test_wire_compress_roundtrip(monkeypatch):
+    monkeypatch.setenv("PL_WIRE_COMPRESS", "zlib:0")
+    d = Dictionary([f"svc-{i}" for i in range(64)])
+    hb = HostBatch(
+        dtypes={"svc": DT.STRING, "v": DT.INT64},
+        dicts={"svc": d},
+        cols={"svc": np.zeros(50_000, dtype=np.int32),
+              "v": np.zeros(50_000, dtype=np.int64)},
+    )
+    frame = wire.encode_host_batch(hb)
+    raw_nbytes = hb.cols["svc"].nbytes + hb.cols["v"].nbytes
+    assert len(frame) < raw_nbytes // 10  # zeros compress hard
+    # the decoder honors the header regardless of the local setting
+    monkeypatch.delenv("PL_WIRE_COMPRESS")
+    kind, got = wire.decode_frame(frame)
+    assert kind == "host_batch"
+    np.testing.assert_array_equal(got.cols["v"], hb.cols["v"])
+    np.testing.assert_array_equal(got.cols["svc"], hb.cols["svc"])
+    assert got.dicts["svc"].values() == d.values()
+
+
+def test_wire_compress_incompressible_ships_raw(monkeypatch):
+    monkeypatch.setenv("PL_WIRE_COMPRESS", "zlib:0")
+    rng = np.random.default_rng(0)
+    hb = HostBatch(dtypes={"v": DT.INT64}, dicts={},
+                   cols={"v": rng.integers(0, 2**62, 100_000)})
+    frame = wire.encode_host_batch(hb)
+    import json as _json
+    import struct
+
+    hlen = struct.unpack_from("<4sI", frame)[1]
+    hdr = _json.loads(frame[8:8 + hlen])
+    assert "comp" not in hdr  # compression would have grown it
+    _, got = wire.decode_frame(frame)
+    np.testing.assert_array_equal(got.cols["v"], hb.cols["v"])
+
+
+def test_wire_compress_rejects_announced_bomb(monkeypatch):
+    import json as _json
+    import struct
+
+    from pixie_tpu.status import InvalidArgument
+
+    monkeypatch.setenv("PL_WIRE_COMPRESS", "zlib:0")
+    hb = HostBatch(dtypes={"v": DT.INT64}, dicts={},
+                   cols={"v": np.zeros(100_000, dtype=np.int64)})
+    frame = wire.encode_host_batch(hb)
+    hlen = struct.unpack_from("<4sI", frame)[1]
+    hdr = _json.loads(frame[8:8 + hlen])
+    assert "comp" in hdr
+    hdr["comp"]["raw"] = wire.MAX_WIRE_BYTES + 1
+    newhdr = _json.dumps(hdr).encode()
+    tampered = struct.pack("<4sI", wire.MAGIC, len(newhdr)) + newhdr + frame[8 + hlen:]
+    with pytest.raises(InvalidArgument):
+        wire.decode_frame(tampered)
+
+
+@pytest.mark.parametrize("announced", [100, 0])  # 0: zlib max_length=0 = unlimited
+def test_wire_bomb_with_small_announced_raw_stops_early(monkeypatch, announced):
+    """A blob whose real expansion dwarfs its announced size must be
+    rejected WITHOUT materializing the expansion (the decompressor runs
+    with max_length, not checked after the fact)."""
+    import json as _json
+    import struct
+
+    from pixie_tpu.status import InvalidArgument
+
+    monkeypatch.setenv("PL_WIRE_COMPRESS", "zlib:0")
+    hb = HostBatch(dtypes={"v": DT.INT64}, dicts={},
+                   cols={"v": np.zeros(8_000_000, dtype=np.int64)})  # 64 MB raw
+    frame = wire.encode_host_batch(hb)
+    hlen = struct.unpack_from("<4sI", frame)[1]
+    hdr = _json.loads(frame[8:8 + hlen])
+    assert "comp" in hdr
+    hdr["comp"]["raw"] = announced  # lie: tiny announced size, huge expansion
+    newhdr = _json.dumps(hdr).encode()
+    tampered = (struct.pack("<4sI", wire.MAGIC, len(newhdr)) + newhdr
+                + frame[8 + hlen:])
+    import tracemalloc
+
+    tracemalloc.start()
+    with pytest.raises(InvalidArgument):
+        wire.decode_frame(tampered)
+    _cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert peak < 8 << 20  # nowhere near the 64 MB expansion
+
+
+def test_wire_empty_string_dictionary_roundtrip():
+    hb = HostBatch(
+        dtypes={"svc": DT.STRING}, dicts={"svc": Dictionary([])},
+        cols={"svc": np.empty(0, dtype=np.int32)},
+    )
+    _, got = wire.decode_frame(wire.encode_host_batch(hb))
+    assert got.dicts["svc"].values() == []
+    assert got.cols["svc"].shape == (0,)
+
+
+def test_wire_string_dict_ships_as_strbuf_not_json():
+    import json as _json
+    import struct
+
+    vals = ["svc/%dé" % i for i in range(100)]  # non-ASCII too
+    hb = HostBatch(
+        dtypes={"svc": DT.STRING}, dicts={"svc": Dictionary(vals)},
+        cols={"svc": np.arange(100, dtype=np.int32)},
+    )
+    frame = wire.encode_host_batch(hb)
+    hlen = struct.unpack_from("<4sI", frame)[1]
+    hdr = _json.loads(frame[8:8 + hlen])
+    assert hdr["meta"]["dicts"]["svc"] == {"strbuf": True}  # no jsonvals
+    _, got = wire.decode_frame(frame)
+    assert got.dicts["svc"].values() == vals
