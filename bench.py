@@ -347,6 +347,60 @@ px.display(df, 'win')
     return rows / secs
 
 
+def bench_interactive(rows, repeats):
+    """Explicit interactive-latency config (named `interactive_1m`; VERDICT
+    r5 lost this point to output truncation, so it is now a first-class
+    config recorded every round): routed and forced-TPU p50_ms + vs_pandas
+    at 1M rows, plus a warm repeated-query loop over a LocalCluster — the
+    dashboard shape — exercising the materialized-view hit path, where the
+    second and later runs answer from standing partial-agg state."""
+    from pixie_tpu.engine.executor import CPU_CROSSOVER_ROWS
+    from pixie_tpu.parallel.cluster import LocalCluster
+    from pixie_tpu.table import TableStore
+
+    ts = TableStore()
+    build_http_table(ts, rows)
+    reps = max(repeats, 7)
+    eng, times = bench_config1(ts, rows, reps, with_times=True)
+    base = pandas_config1(ts, rows, max(1, repeats - 1))
+    out = {
+        "rows": rows,
+        "rows_per_sec": round(eng),
+        "vs_pandas": round(eng / base, 2),
+        "p50_ms": round(_p50(times) * 1000, 1),
+    }
+    if rows <= CPU_CROSSOVER_ROWS:
+        tpu_eng, tpu_times = bench_config1(ts, rows, reps, with_times=True,
+                                           backend="tpu")
+        out["tpu_path_p50_ms"] = round(_p50(tpu_times) * 1000, 1)
+        out["tpu_path_vs_pandas"] = round(tpu_eng / base, 2)
+    # warm repeated dashboard loop: run 1 registers the view, run 2 builds
+    # the standing state, runs 3+ fold only the (empty) delta and finalize
+    cluster = LocalCluster({"pem0": ts})
+    script = """
+df = px.DataFrame(table='http_events')
+df = df[df.status != 404]
+df = df.groupby(['service', 'status']).agg(
+    cnt=('latency', px.count), avg_lat=('latency', px.mean), p50=('latency', px.p50))
+px.display(df, 'output')
+"""
+    cluster.query(script)
+    cluster.query(script)
+    w_times, last = _times(lambda: cluster.query(script)["output"], reps)
+    assert last.num_rows > 0
+    mv = (last.exec_stats["agents"].get("pem0") or {}).get("matview") or {}
+    views = cluster.matviews("pem0").stats()
+    warm_p50 = _p50(w_times)
+    out["warm_matview"] = {
+        "p50_ms": round(warm_p50 * 1000, 1),
+        "vs_pandas": round((rows / warm_p50) / base, 2),
+        "hit": bool(mv.get("hit")),
+        "view_hits": sum(v["hits"] for v in views),
+        "state_bytes": sum(v["state_bytes"] for v in views),
+    }
+    return out
+
+
 def kernel_split(plan, ts):
     """→ {e2e_ms, analyze_e2e_ms, op_wall_ms, device_kernel_ms,
     device_frac_of_e2e}.
@@ -355,9 +409,14 @@ def kernel_split(plan, ts):
     pipeline and the readback is one overlapped wave.  device_kernel_ms
     comes from a separate analyze run that blocks after every feed — that
     serializes the pipeline (its own e2e is reported as analyze_e2e_ms, do
-    not compare it to e2e_ms), so device_kernel_ms is an upper bound on
-    device time and device_frac_of_e2e (min(dev, e2e)/e2e) a lower bound
-    on device occupancy during the production run.
+    not compare it to e2e_ms).  device_frac_of_e2e is the UN-CLAMPED ratio
+    device_kernel_ms / e2e_ms (VERDICT r5: the old min(dev, e2e)/e2e
+    clamped to exactly 1.0 whenever the serialized analyze device time
+    exceeded the production e2e, which made every occupancy claim
+    unfalsifiable).  Values > 1.0 mean the serialized measurement exceeds
+    the pipelined wall time — evidence of overlap, NOT of full occupancy;
+    the raw numerator (device_kernel_ms) and denominator (e2e_ms) ship
+    alongside so the ratio can always be audited.
     """
     from pixie_tpu.engine.executor import PlanExecutor
 
@@ -377,7 +436,7 @@ def kernel_split(plan, ts):
         "analyze_e2e_ms": round(analyze_e2e * 1000, 1),
         "op_wall_ms": round(op_wall / 1e6, 1),
         "device_kernel_ms": round(dev / 1e6, 1),
-        "device_frac_of_e2e": round(min(dev / 1e9, e2e) / e2e, 3),
+        "device_frac_of_e2e": round((dev / 1e9) / e2e, 3),
     }
 
 
@@ -470,7 +529,8 @@ def main():
                     help="guard mode (no benchmarks run): diff BENCH_JSON "
                          "(default: the newest BENCH_r*.json) against the "
                          "prior round and exit 1 on any "
-                         ">--regression-threshold rows_per_sec drop")
+                         ">--regression-threshold rows_per_sec drop or "
+                         "p50_ms latency rise")
     ap.add_argument("--regression-threshold", type=float, default=0.15,
                     help="fractional drop that fails --check-regressions")
     args = ap.parse_args()
@@ -536,6 +596,7 @@ def main():
             }
         del ts
 
+    interactive = bench_interactive(min(args.rows, 1_000_000), args.repeats)
     cfg3 = bench_config3(args.join_rows, args.repeats)
     dev_join = bench_device_join(min(args.join_rows, 16_000_000))
     cfg4 = bench_config4(args.dist_rows, max(1, args.repeats - 1))
@@ -555,6 +616,7 @@ def main():
                 "rows_per_sec": round(cfg2),
                 "vs_pandas": round(cfg2 / cfg2_base, 2),
             },
+            "interactive_1m": interactive,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
                 "rows_per_sec": round(dev_join),
@@ -608,11 +670,8 @@ def main():
     if regressions:
         result["regressions_vs_prior_round"] = regressions
         print(
-            "BENCH REGRESSION (>20% drop vs prior round): "
-            + "; ".join(
-                f"{r['key']}: {r['prior']} -> {r['now']} rows/s "
-                f"({r['drop_pct']}%)" for r in regressions
-            ),
+            "BENCH REGRESSION (>20% vs prior round): "
+            + "; ".join(_format_regression(r) for r in regressions),
             file=sys.stderr,
         )
     print(json.dumps(result))
@@ -661,9 +720,39 @@ def bench_points(doc):
     return out
 
 
+def bench_latency_points(doc):
+    """{key: (p50_ms, shape_rows)} for every latency-keyed point — sweep and
+    config p50s (routed, forced-TPU, and warm-matview), shape-matched like
+    bench_points so a --smoke run never compares against a full run."""
+    out = {}
+    top_rows = doc.get("rows")
+
+    def grab(prefix, v, rows):
+        for lk in ("p50_ms", "tpu_path_p50_ms"):
+            val = v.get(lk)
+            if isinstance(val, (int, float)):
+                out[f"{prefix}.{lk}"] = (val, rows)
+
+    for k, v in (doc.get("configs") or {}).items():
+        if not isinstance(v, dict):
+            continue
+        rows = v.get("rows", top_rows)
+        grab(f"configs.{k}", v, rows)
+        for sub, sv in v.items():
+            if isinstance(sv, dict):
+                grab(f"configs.{k}.{sub}", sv, rows)
+    for k, v in (doc.get("sweep") or {}).items():
+        if isinstance(v, dict):
+            grab(f"sweep.{k}", v, int(k))
+    return out
+
+
 def compare_bench(prior, current, threshold):
-    """[{key, prior, now, drop_pct}] for every shape-matched rows_per_sec
-    point that dropped more than `threshold` (a 0..1 fraction)."""
+    """Regressions between two bench docs, shape-matched points only:
+    rows_per_sec DROPS beyond `threshold` ({key, prior, now, drop_pct}) and
+    p50_ms latency RISES beyond `threshold` ({key, prior, now, rise_pct}) —
+    a latency-keyed config must not regress just because throughput keys
+    held (the interactive path is latency-bound, not throughput-bound)."""
     old, new = bench_points(prior), bench_points(current)
     regs = []
     for k, (prev, prev_rows) in old.items():
@@ -674,7 +763,24 @@ def compare_bench(prior, current, threshold):
         if drop > threshold:
             regs.append({"key": k, "prior": prev, "now": now,
                          "drop_pct": round(drop * 100, 1)})
+    lold, lnew = bench_latency_points(prior), bench_latency_points(current)
+    for k, (prev, prev_rows) in lold.items():
+        now, now_rows = lnew.get(k, (None, None))
+        if now is None or not prev or prev_rows != now_rows:
+            continue
+        rise = (now - prev) / prev
+        if rise > threshold:
+            regs.append({"key": k, "prior": prev, "now": now,
+                         "rise_pct": round(rise * 100, 1)})
     return regs
+
+
+def _format_regression(r) -> str:
+    if "rise_pct" in r:
+        return (f"{r['key']}: {r['prior']} -> {r['now']} ms p50 "
+                f"(+{r['rise_pct']}%)")
+    return (f"{r['key']}: {r['prior']} -> {r['now']} rows/s "
+            f"(-{r['drop_pct']}%)")
 
 
 def _regression_check(result, threshold=0.20):
@@ -692,8 +798,10 @@ def _regression_check(result, threshold=0.20):
 def check_regressions(current_path=None, threshold=0.15):
     """The CI guard (`bench.py --check-regressions [FILE]`): diff a bench
     result JSON against the prior round's BENCH file and exit nonzero on any
-    >threshold drop in a configs.*/sweep.* rows_per_sec key — so an ingest
-    regression fails the PR instead of surfacing in the next round's verdict.
+    >threshold drop in a configs.*/sweep.* rows_per_sec key OR >threshold
+    rise in a latency (p50_ms / tpu_path_p50_ms) key — so an ingest or
+    interactive-latency regression fails the PR instead of surfacing in the
+    next round's verdict.
 
     FILE may be a raw bench output line or a BENCH_r*.json wrapper; without
     FILE the newest BENCH_r*.json is the "current" round and the guard diffs
@@ -722,8 +830,8 @@ def check_regressions(current_path=None, threshold=0.15):
     base = os.path.basename(prior_path)
     if regs:
         for r in regs:
-            print(f"REGRESSION {r['key']}: {r['prior']} -> {r['now']} rows/s "
-                  f"(-{r['drop_pct']}% vs {base})", file=sys.stderr)
+            print(f"REGRESSION {_format_regression(r)} vs {base}",
+                  file=sys.stderr)
         return 1
     print(f"check-regressions: no >{round(threshold * 100)}% drops vs {base}",
           file=sys.stderr)

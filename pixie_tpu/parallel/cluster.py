@@ -131,6 +131,22 @@ class LocalCluster:
         self.planner = DistributedPlanner(self.spec)
         #: per-agent tracepoint managers (created on first mutation)
         self._tp_managers: dict = {}
+        #: per-agent standing-view maintainers (pixie_tpu.matview): repeated
+        #: partial-agg fragments answer from O(delta)-refreshed state
+        self._mv_managers: dict = {}
+
+    def matviews(self, agent_name: str):
+        # under _mesh_lock: concurrent execute() calls (e.g. the web UI's
+        # poll loop overlapping a manual run) must not each construct a
+        # manager and orphan one side's view registrations
+        with self._mesh_lock:
+            mgr = self._mv_managers.get(agent_name)
+            if mgr is None:
+                from pixie_tpu.matview import MatViewManager
+
+                mgr = self._mv_managers[agent_name] = MatViewManager(
+                    self.stores[agent_name], self.registry)
+            return mgr
 
     def schemas(self) -> dict:
         return self.spec.combined_schemas()
@@ -200,6 +216,16 @@ class LocalCluster:
         items = list(dp.agent_plans.items())
 
         def run_one(agent_name, plan):
+            # Standing-view fast path (same contract as the networked agent):
+            # first sight registers, later sights answer from O(delta)-
+            # refreshed state; analyze runs bypass to measure the real scan.
+            if not analyze:
+                served = self.matviews(agent_name).serve(
+                    plan, route_scale=len(items),
+                    mesh=self._agent_mesh(agent_name))
+                if served is not None:
+                    cid, pb, info = served
+                    return agent_name, {cid: pb}, {"matview": info}
             # route_scale: CPU/TPU routing must see the QUERY size (all
             # agents' shards), not this agent's shard alone — see
             # executor._route_backend.

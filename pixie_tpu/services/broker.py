@@ -670,6 +670,9 @@ class Broker:
         self, script, func, func_args, now, default_limit, analyze,
         funcs=None,
     ) -> tuple[dict[str, QueryResult], dict]:
+        import time as _time
+
+        from pixie_tpu import metrics as _metrics
         from pixie_tpu.compiler import compile_pxl, compile_pxl_funcs
         from pixie_tpu.status import Internal, Unavailable
 
@@ -709,6 +712,26 @@ class Broker:
         reg = self.udf_registry
         if reg is None:
             from pixie_tpu.udf import registry as reg
+        # Broker-side view matcher: which agent fragments have a standing-
+        # query shape?  The agents decide (and do) the actual serving — this
+        # is the control-plane ledger that makes hit/miss observable per
+        # query (stats["matview"], px_broker_matview_* counters, and a
+        # matview_hit span when the whole query answered from views).
+        # Disabled subsystem = no ledger: otherwise every query would pay
+        # the canonicalize+hash and count as a "miss" for a feature that
+        # is off.
+        import pixie_tpu.matview  # noqa: F401 — defines the PL_MATVIEW_* flags
+
+        from pixie_tpu import flags as _flags
+
+        mv_keys = {}
+        if _flags.get("PL_MATVIEW_ENABLED"):
+            from pixie_tpu.matview.registry import plan_view_key
+
+            mv_keys = {
+                name: k for name, plan in dp.agent_plans.items()
+                if (k := plan_view_key(plan, reg)) is not None
+            }
         with self._qlock:
             self._req_counter += 1
             req_id = f"q{self._req_counter}"
@@ -811,6 +834,36 @@ class Broker:
                 for r in results.values():
                     restamp_result(r, q.plan, sstore, reg)
                 stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+                if mv_keys:
+                    served = {
+                        a: s["matview"] for a, s in ctx.agent_stats.items()
+                        if isinstance(s, dict) and s.get("matview")
+                    }
+                    hits = sum(1 for i in served.values() if i.get("hit"))
+                    stats["matview"] = {
+                        "eligible_agents": len(mv_keys),
+                        "agents_hit": hits,
+                        "rows_folded": sum(
+                            int(i.get("rows_folded", 0))
+                            for i in served.values()),
+                        "keys": sorted(set(mv_keys.values())),
+                    }
+                    if hits and hits == len(dp.agent_plans):
+                        # the ENTIRE scan side answered from standing state:
+                        # this query's cost was delta folds + one finalize
+                        _metrics.counter_inc(
+                            "px_broker_matview_hit_queries_total",
+                            help_="queries fully answered from standing "
+                                  "view state on every agent")
+                        trace.event_span(
+                            "matview_hit", _time.time_ns(), 0,
+                            agents=hits,
+                            rows_folded=stats["matview"]["rows_folded"])
+                    else:
+                        _metrics.counter_inc(
+                            "px_broker_matview_miss_queries_total",
+                            help_="view-eligible queries that rescanned on "
+                                  "at least one agent")
                 #: streaming-merge observability: merge_overlapped=True means
                 #: the first chunk folded BEFORE the last agent's terminal
                 #: frame — merge cost hid under the slowest agent's compute

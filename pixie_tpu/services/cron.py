@@ -30,6 +30,53 @@ class CronScript:
     error_count: int = 0
 
 
+class Ticker:
+    """Generic periodic maintenance job on a daemon thread — the cron-runner
+    tick discipline without the script registry.  Services hang incremental
+    maintainers off it (matview standing-view refresh, future compactors);
+    a failing tick is counted, never raised (maintenance must not kill its
+    host service)."""
+
+    def __init__(self, name: str, interval_s: float, fn: Callable):
+        if interval_s <= 0:
+            raise InvalidArgument("ticker interval must be positive")
+        self.name = name
+        self.interval_s = float(interval_s)
+        self._fn = fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tick_count = 0
+        self.error_count = 0
+
+    def start(self) -> "Ticker":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(timeout=self.interval_s):
+                try:
+                    self._fn()
+                    self.tick_count += 1
+                except Exception:
+                    self.error_count += 1
+                    from pixie_tpu import metrics as _metrics
+
+                    _metrics.counter_inc("px_ticker_errors_total",
+                                         labels={"ticker": self.name})
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"pixie-ticker-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
 class CronScriptRunner:
     """Background executor over a persisted script set."""
 

@@ -217,6 +217,17 @@ class Table:
         with self._lock:
             return self._next_row_id + self._hot_rows
 
+    def first_row_id(self) -> int:
+        """Row id of the oldest RETAINED row — the ring-buffer expiry
+        frontier.  Monotone non-decreasing: expiry only pops sealed batches
+        from the head, and hot rows (ids ≥ _next_row_id) never expire.
+        Delta cursors (table.delta) compare their coverage against this to
+        detect retention trimming past their watermark."""
+        with self._lock:
+            if self._sealed:
+                return self._sealed[0].row_id_start
+            return self._next_row_id
+
     def cursor_since(
         self,
         row_id: int,

@@ -65,13 +65,20 @@ svg text {{ fill: #9aa6b2; font-size: 10px; }}
 <header><a href="/">pixie-tpu live</a><b>{title}</b></header>
 <main>
 <form class="vars" id="vars" onsubmit="run(); return false;">{var_inputs}
-<button type="submit">Run</button><span id="status"></span></form>
+<button type="submit">Run</button>
+<label>auto-refresh s <input id="refresh" value="{refresh_s}" size="3"
+ onchange="schedule()"></label><span id="status"></span></form>
 <details style="margin-top:10px"><summary style="cursor:pointer;color:#9aa6b2">script source (edit &amp; re-run)</summary>
 <textarea id="source">{source}</textarea></details>
 <div class="grid" id="grid"></div>
 </main>
 <script>
+let gen = 0;        // drop out-of-order responses: only the newest renders
+let inflight = false;
 async function run() {{
+  if (inflight) return;  // a slow query must not pile up overlapping runs
+  inflight = true;
+  const my = ++gen;
   const st = document.getElementById('status');
   st.textContent = 'running…';
   const vars = {{}};
@@ -83,6 +90,7 @@ async function run() {{
       headers: {{'X-Pixie-Session': {session_token}}},
       body: JSON.stringify(body)}});
     const data = await r.json();
+    if (my !== gen) return;  // a newer run superseded this response
     const grid = document.getElementById('grid');
     grid.innerHTML = '';
     if (data.error) {{ grid.innerHTML = '<div class="widget err">' + data.error + '</div>'; }}
@@ -93,9 +101,22 @@ async function run() {{
       grid.appendChild(d);
     }}
     st.textContent = ((performance.now() - t0) | 0) + ' ms';
-  }} catch (e) {{ st.textContent = 'error: ' + e; }}
+  }} catch (e) {{ if (my === gen) st.textContent = 'error: ' + e; }}
+  finally {{ inflight = false; }}
+}}
+let timer = null;
+function schedule() {{
+  // Dashboard poll loop: re-run the same script every N seconds.  Repeated
+  // runs hit the engine's standing materialized views, so each poll costs
+  // O(rows since last poll) server-side instead of a full rescan.  Ticks
+  // landing while a run is in flight are skipped (run()'s inflight guard),
+  // so a slow script degrades to back-to-back runs, never a pile-up.
+  if (timer !== null) {{ clearInterval(timer); timer = null; }}
+  const s = parseFloat(document.getElementById('refresh').value);
+  if (s > 0) timer = setInterval(run, s * 1000);
 }}
 run();
+schedule();
 </script>
 </body></html>"""
 
@@ -302,9 +323,14 @@ class LiveServer:
     """
 
     def __init__(self, runner: Callable, scripts_dir=DEFAULT_SCRIPTS,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 refresh_s: float = 5.0):
         self.runner = runner
         self.scripts_dir = pathlib.Path(scripts_dir)
+        #: default dashboard poll cadence (seconds; 0 disables). Each poll
+        #: re-runs the same script, which the engine answers from standing
+        #: matview state — the workload the subsystem exists for.
+        self.refresh_s = refresh_s
         # Per-session token embedded in served pages; POST /api/run requires
         # it, so a drive-by cross-origin page (which cannot read our HTML)
         # cannot trigger script execution or tracepoint mutations.  The
@@ -443,6 +469,7 @@ class LiveServer:
             title=_esc(name), var_inputs=var_inputs,
             source=_esc(source), script_json=json.dumps(name),
             session_token=json.dumps(self.session_token),
+            refresh_s=_esc(f"{self.refresh_s:g}"),
         )
 
     # ------------------------------------------------------------------- api

@@ -1,18 +1,26 @@
 """Bench regression guard (`bench.py --check-regressions`): the tier-1 gate
-that fails a PR on >15% rows_per_sec drops instead of letting them surface
-in the next round's verdict (the r05 ingest regression path)."""
+that fails a PR on >15% rows_per_sec drops OR >15% p50_ms latency rises
+instead of letting them surface in the next round's verdict (the r05 ingest
+regression path; the r5 interactive-latency blind spot)."""
 import json
 
 import bench
 
 
-def _doc(ingest=22_000_000, join=125_000_000, rows=64_000_000):
+def _doc(ingest=22_000_000, join=125_000_000, rows=64_000_000,
+         p50=80.0, warm_p50=12.0):
     return {
         "rows": rows,
-        "sweep": {"1000000": {"rows_per_sec": 50_000_000}},
+        "sweep": {"1000000": {"rows_per_sec": 50_000_000, "p50_ms": 20.0,
+                              "tpu_path_p50_ms": 95.0}},
         "configs": {
             "ingest_microbench": {"rows_per_sec": ingest},
             "3_flow_join": {"rows_per_sec": join, "rows": 16_000_000},
+            "interactive_1m": {
+                "rows": 1_000_000, "rows_per_sec": 12_500_000,
+                "p50_ms": p50, "tpu_path_p50_ms": 110.0,
+                "warm_matview": {"p50_ms": warm_p50, "vs_pandas": 9.0},
+            },
         },
     }
 
@@ -40,6 +48,37 @@ def test_compare_only_shape_matched_points():
     now["sweep"] = {"200000": {"rows_per_sec": 1_000}}  # different sweep point
     regs = bench.compare_bench(prior, now, threshold=0.15)
     assert regs == []
+
+
+def test_latency_rise_flags_regression():
+    """A >15% p50 increase fails even when every rows_per_sec key held — the
+    interactive path is latency-bound (ISSUE-3 satellite)."""
+    prior, now = _doc(), _doc(p50=100.0)  # +25% routed p50
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert [r["key"] for r in regs] == ["configs.interactive_1m.p50_ms"]
+    assert regs[0]["rise_pct"] > 15
+    assert "REGRESSION" not in bench._format_regression(regs[0])
+    assert "ms p50" in bench._format_regression(regs[0])
+
+
+def test_latency_covers_nested_and_sweep_points():
+    pts = bench.bench_latency_points(_doc())
+    assert pts["sweep.1000000.p50_ms"] == (20.0, 1_000_000)
+    assert pts["sweep.1000000.tpu_path_p50_ms"] == (95.0, 1_000_000)
+    assert pts["configs.interactive_1m.p50_ms"] == (80.0, 1_000_000)
+    assert pts["configs.interactive_1m.warm_matview.p50_ms"] == (
+        12.0, 1_000_000)
+    # warm-matview regression is caught through the nested point
+    regs = bench.compare_bench(_doc(), _doc(warm_p50=30.0), threshold=0.15)
+    assert [r["key"] for r in regs] == [
+        "configs.interactive_1m.warm_matview.p50_ms"]
+
+
+def test_latency_tolerates_improvement_and_shape_mismatch():
+    assert bench.compare_bench(_doc(), _doc(p50=40.0), threshold=0.15) == []
+    now = _doc(p50=500.0)
+    now["configs"]["interactive_1m"]["rows"] = 200_000  # smoke shape
+    assert bench.compare_bench(_doc(), now, threshold=0.15) == []
 
 
 def test_check_regressions_cli_paths(tmp_path, capsys):

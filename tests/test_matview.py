@@ -1,0 +1,351 @@
+"""Materialized-view correctness edges + state-budget hygiene.
+
+Covers the ISSUE-3 matrix: warm results equal to cold execution after
+out-of-order ingest, invalidation on retention trimming / schema change /
+dead cursors, the fallback to a full rescan, LRU eviction under
+PL_MATVIEW_MAX_STATE_MB, and flag-off equivalence.  Aggregates in the exact-
+equality tests are chosen integer-exact (count / sum over integral values /
+min / max) so "bit-equal" is well-defined across fold orders.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pixie_tpu import flags
+from pixie_tpu.matview import MatViewManager
+from pixie_tpu.matview.registry import match_prefix, plan_view_key, view_key
+from pixie_tpu.parallel.cluster import LocalCluster
+from pixie_tpu.plan.plan import (
+    AggExpr,
+    AggOp,
+    MemorySourceOp,
+    Plan,
+    ResultSinkOp,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+REL = Relation.of(
+    ("time_", DT.TIME64NS), ("service", DT.STRING),
+    ("latency", DT.FLOAT64), ("status", DT.INT64),
+)
+
+SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 500]
+df = df.groupby('service').agg(
+    cnt=('latency', px.count), s=('latency', px.sum),
+    lo=('latency', px.min), hi=('latency', px.max))
+px.display(df, 'out')
+"""
+
+
+@pytest.fixture(autouse=True)
+def _matview_on():
+    flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+    flags.set_for_testing("PL_MATVIEW_MAX_STATE_MB", 256)
+    yield
+    flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+    flags.set_for_testing("PL_MATVIEW_MAX_STATE_MB", 256)
+
+
+def _write(t, n, seed, t0=0, shuffle=True):
+    """n rows with OUT-OF-ORDER times (ingest order != time order)."""
+    rng = np.random.default_rng(seed)
+    times = np.arange(t0, t0 + n, dtype=np.int64) * 1000
+    if shuffle:
+        rng.shuffle(times)
+    t.write({
+        "time_": times,
+        "service": rng.choice(["cart", "auth", "web"], n).tolist(),
+        "latency": rng.integers(0, 1000, n).astype(np.float64),
+        "status": rng.choice([200, 500], n),
+    })
+
+
+def _mkstore(seed, n=30_000, **kw):
+    ts = TableStore()
+    t = ts.create("http_events", REL, batch_rows=4096, **kw)
+    _write(t, n, seed)
+    return ts
+
+
+def _df(res):
+    return res.to_pandas().sort_values("service").reset_index(drop=True)
+
+
+def _cold(stores, script=SCRIPT, **kw):
+    """Oracle: the same query on a FRESH cluster with matview disabled."""
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    try:
+        return _df(LocalCluster(stores).query(script, **kw)["out"])
+    finally:
+        flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+
+
+def _hits(res):
+    return {a: (s.get("matview") or {}) for a, s in
+            res.exec_stats["agents"].items()}
+
+
+# ------------------------------------------------------------- equivalence
+
+
+def test_warm_equals_cold_after_out_of_order_ingest():
+    stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
+    cluster = LocalCluster(stores)
+    cluster.query(SCRIPT)  # 1st sight: register (normal path)
+    warm1 = _df(cluster.query(SCRIPT)["out"])  # 2nd: build + serve
+    assert warm1.equals(_cold(stores))
+    # out-of-order delta: later-ingested rows carry EARLIER times
+    _write(stores["pem1"].table("http_events"), 5_000, seed=7, t0=-5_000)
+    res = cluster.query(SCRIPT)["out"]
+    mv = _hits(res)
+    assert all(i.get("hit") for i in mv.values()), mv
+    assert mv["pem1"]["rows_folded"] == 5_000  # O(delta), not O(table)
+    assert mv["pem2"]["rows_folded"] == 0
+    assert _df(res).equals(_cold(stores))
+
+
+def test_windowed_agg_serves_from_view():
+    script = """
+df = px.DataFrame(table='http_events')
+df.time_ = px.bin(df.time_, px.seconds(10))
+df = df.groupby('time_').agg(
+    cnt=('latency', px.count), hi=('latency', px.max))
+px.display(df, 'out')
+"""
+    stores = {"pem1": _mkstore(3)}
+    cluster = LocalCluster(stores)
+    cluster.query(script)
+    res = cluster.query(script)["out"]
+    assert all(i.get("hit") for i in _hits(res).values())
+    assert _df_time(res).equals(_cold_time(stores, script))
+
+
+def _df_time(res):
+    return res.to_pandas().sort_values("time_").reset_index(drop=True)
+
+
+def _cold_time(stores, script):
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    try:
+        return _df_time(LocalCluster(stores).query(script)["out"])
+    finally:
+        flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+
+
+def test_disabling_flag_yields_identical_results():
+    stores = {"pem1": _mkstore(4)}
+    cluster = LocalCluster(stores)
+    cluster.query(SCRIPT)
+    warm = _df(cluster.query(SCRIPT)["out"])
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    cold = _df(cluster.query(SCRIPT)["out"])
+    flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+    assert warm.equals(cold)  # byte-identical frames (integer-exact aggs)
+
+
+# ------------------------------------------------------------ invalidation
+
+
+def test_invalidation_on_retention_trim_past_cursor():
+    # tiny byte budget: new writes expire old sealed batches
+    stores = {"pem1": _mkstore(5, n=20_000, max_bytes=1 << 20)}
+    t = stores["pem1"].table("http_events")
+    cluster = LocalCluster(stores)
+    cluster.query(SCRIPT)
+    res = cluster.query(SCRIPT)["out"]
+    assert all(i.get("hit") for i in _hits(res).values())
+    first_before = t.first_row_id()
+    # trim past the view's base: the standing state now covers expired rows
+    _write(t, 40_000, seed=6, t0=20_000)
+    assert t.first_row_id() > first_before
+    res2 = cluster.query(SCRIPT)["out"]
+    mv = _hits(res2)["pem1"]
+    assert mv.get("hit") and mv.get("rebuilt") in ("trimmed", "gap")
+    assert _df(res2).equals(_cold(stores))
+
+
+def test_schema_change_forces_rebuild():
+    stores = {"pem1": _mkstore(8)}
+    cluster = LocalCluster(stores)
+    cluster.query(SCRIPT)
+    assert all(i.get("hit") for i in _hits(cluster.query(SCRIPT)["out"]).values())
+    # drop + recreate under the same name (new uid, fresh data): the view
+    # must detect the stale table and rebuild instead of serving old state
+    stores["pem1"].drop("http_events")
+    t = stores["pem1"].create("http_events", REL, batch_rows=4096)
+    _write(t, 9_000, seed=9)
+    cluster.apply_mutations([])  # refresh planner schemas (no-op mutations)
+    res = cluster.query(SCRIPT)["out"]
+    mv = _hits(res)["pem1"]
+    assert mv.get("hit") and mv.get("rebuilt") == "stale_table"
+    assert _df(res).equals(_cold(stores))
+
+
+def test_dead_cursor_falls_back_to_full_rescan():
+    ts = _mkstore(10, n=8_192, max_bytes=1 << 20)
+    t = ts.table("http_events")
+    mgr = MatViewManager(ts)
+    plan = _partial_plan()
+    assert mgr.serve(plan) is None  # first sight registers only
+    served = mgr.serve(plan)
+    assert served is not None
+    view = mgr._views[plan_view_key(plan)]
+    wm = view.cursor.watermark
+    # expire EVERYTHING the cursor read and then some: unread rows are gone
+    _write(t, 60_000, seed=11, t0=8_192)
+    assert t.first_row_id() > wm  # a dead cursor (gap), not just a trim
+    cid, pb, info = mgr.serve(plan)
+    assert info["rebuilt"] == "gap"
+    # rebuilt state equals a cold partial over the retained rows
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    from pixie_tpu.engine.executor import PlanExecutor
+
+    cold = PlanExecutor(_partial_plan(), ts).run_agent()["mv"]
+    flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+    assert pb.num_groups == cold.num_groups
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(pb.states["cnt"])),
+        np.sort(np.asarray(cold.states["cnt"])))
+
+
+def _partial_plan():
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events"))
+    agg = p.add(AggOp(groups=["service"],
+                      values=[AggExpr("cnt", "count", None)], partial=True),
+                parents=[src])
+    p.add(ResultSinkOp(channel="mv", payload="agg_state"), parents=[agg])
+    return p
+
+
+# ----------------------------------------------------------------- hygiene
+
+
+def test_state_budget_evicts_lru_views():
+    """Every retained view's state stays under PL_MATVIEW_MAX_STATE_MB, with
+    LRU eviction of cold views (the tier-1 hygiene ratchet)."""
+    rng = np.random.default_rng(12)
+    ts = TableStore()
+    rel = Relation.of(("time_", DT.TIME64NS), ("k", DT.INT64),
+                      ("v", DT.FLOAT64))
+    t = ts.create("wide", rel, batch_rows=1 << 14, max_bytes=1 << 30)
+    n = 120_000
+    t.write({"time_": np.arange(n, dtype=np.int64),
+             "k": np.arange(n, dtype=np.int64),  # 120k distinct groups
+             "v": rng.random(n)})
+    mgr = MatViewManager(ts)
+
+    def plan_for(out):
+        p = Plan()
+        src = p.add(MemorySourceOp(table="wide"))
+        agg = p.add(AggOp(groups=["k"],
+                          values=[AggExpr(out, "sum", "v")], partial=True),
+                    parents=[src])
+        p.add(ResultSinkOp(channel="mv", payload="agg_state"), parents=[agg])
+        return p
+
+    plans = [plan_for(o) for o in ("a", "b", "c")]
+    assert len({plan_view_key(p) for p in plans}) == 3
+    for p in plans:
+        mgr.serve(p)  # register
+    served = [mgr.serve(p) for p in plans]
+    assert all(s is not None for s in served)
+    per_view = max(v.state_bytes for v in mgr._views.values())
+    assert per_view > 1 << 20  # the fixture actually stresses the budget
+    budget_mb = max(1, (2 * per_view) >> 20)  # room for ~2 of 3 views
+    flags.set_for_testing("PL_MATVIEW_MAX_STATE_MB", budget_mb)
+    mgr.serve(plans[2])  # re-serve the newest: triggers budget enforcement
+    keys = set(mgr._views)
+    assert plan_view_key(plans[2]) in keys  # the hot view survives
+    assert plan_view_key(plans[0]) not in keys  # the LRU view evicted
+    assert mgr.state_bytes() <= budget_mb << 20
+    from pixie_tpu import metrics
+
+    assert "px_matview_evictions_total" in metrics.render()
+
+
+def test_oversized_single_view_never_retained():
+    ts = _mkstore(13, n=8_192)
+    mgr = MatViewManager(ts)
+    plan = _partial_plan()
+    mgr.serve(plan)
+    flags.set_for_testing("PL_MATVIEW_MAX_STATE_MB", 0)
+    served = mgr.serve(plan)
+    assert served is not None  # the answer is still produced...
+    assert not mgr._views  # ...but a budget-busting view is not retained
+
+
+# ----------------------------------------------------------- eligibility
+
+
+def test_time_bounded_and_limited_plans_are_ineligible():
+    p = Plan()
+    src = p.add(MemorySourceOp(table="http_events", start_time=0,
+                               stop_time=10))
+    agg = p.add(AggOp(groups=["service"],
+                      values=[AggExpr("cnt", "count", None)], partial=True),
+                parents=[src])
+    p.add(ResultSinkOp(channel="mv", payload="agg_state"), parents=[agg])
+    assert match_prefix(p) is None
+
+    from pixie_tpu.plan.plan import LimitOp
+
+    p2 = Plan()
+    src = p2.add(MemorySourceOp(table="http_events"))
+    lim = p2.add(LimitOp(n=10), parents=[src])
+    agg = p2.add(AggOp(groups=["service"],
+                       values=[AggExpr("cnt", "count", None)], partial=True),
+                 parents=[lim])
+    p2.add(ResultSinkOp(channel="mv", payload="agg_state"), parents=[agg])
+    assert match_prefix(p2) is None
+
+
+def test_view_key_stable_across_compilations():
+    k1 = plan_view_key(_partial_plan())
+    k2 = plan_view_key(_partial_plan())
+    assert k1 == k2 and k1 is not None
+    pref = match_prefix(_partial_plan())
+    assert view_key(pref) == k1
+
+
+# ------------------------------------------------------- spans + metrics
+
+
+def test_matview_spans_and_broker_stats():
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+
+    broker = Broker(hb_expiry_s=2.0, query_timeout_s=30.0).start()
+    stores = {"pem1": _mkstore(14)}
+    agent = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
+                  heartbeat_s=0.2).start()
+    try:
+        broker.execute_script(SCRIPT)
+        _results, stats = broker.execute_script(SCRIPT)
+        assert stats["matview"]["eligible_agents"] == 1
+        assert stats["matview"]["agents_hit"] == 1
+        # matview_refresh / matview_hit spans landed in the spans table
+        import time
+
+        names = set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            cur = stores["pem1"].table("self_telemetry.spans").cursor()
+            d = stores["pem1"].table("self_telemetry.spans").dictionaries["name"]
+            names = {
+                str(d.decode([c])[0])
+                for rb, _rid, _gen in cur
+                for c in rb.columns["name"][: rb.num_valid]
+            }
+            if {"matview_refresh", "matview_hit"} <= names:
+                break
+            time.sleep(0.05)
+        assert "matview_refresh" in names
+        assert "matview_hit" in names
+    finally:
+        agent.stop()
+        broker.stop()
