@@ -205,6 +205,10 @@ class CompiledQuery:
     #: tracepoint deployments the caller must apply before/with execution
     #: (reference: CompileMutations → MutationExecutor, mutation_executor.go:84)
     mutations: list = dataclasses.field(default_factory=list)
+    #: True when the compilation READ the query timestamp (relative time
+    #: ranges, px.now()) — such plans bake `now` and are never plan-cacheable.
+    #: Defaults True so callers constructing CompiledQuery directly stay safe.
+    now_sensitive: bool = True
 
 
 def _coerce_arg(value, annotation):
@@ -290,7 +294,8 @@ def compile_pxl(
     plan = optimize(ctx.plan, default_limit=default_limit)
     return CompiledQuery(plan=plan,
                          sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")],
-                         now=ctx.now, mutations=list(ctx.mutations))
+                         now=ctx._now, mutations=list(ctx.mutations),
+                         now_sensitive=ctx.now_consumed)
 
 
 def compile_pxl_funcs(
@@ -349,4 +354,5 @@ def compile_fn(build, schemas: dict[str, Relation], registry=None, now=None) -> 
     plan = optimize(ctx.plan)
     return CompiledQuery(plan=plan,
                          sink_names=[s.name for s in ctx.sinks if hasattr(s, "name")],
-                         now=ctx.now, mutations=list(ctx.mutations))
+                         now=ctx._now, mutations=list(ctx.mutations),
+                         now_sensitive=ctx.now_consumed)

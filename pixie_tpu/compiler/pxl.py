@@ -43,10 +43,20 @@ class CompileCtx:
         self.plan = Plan()
         self.schemas = dict(schemas)  # pxtrace may add probe output tables
         self.registry = registry
-        self.now = now
+        self._now = now
+        #: True once any compilation step READ the query timestamp — the
+        #: compiled plan then bakes `now` (relative time ranges, px.now())
+        #: and must not be served from a whole-query plan cache, where a
+        #: later query would silently reuse an old timestamp.
+        self.now_consumed = False
         self.sinks: list[MemorySinkOp] = []
         #: tracepoint deployments etc. (reference CompileMutations path)
         self.mutations: list[dict] = []
+
+    @property
+    def now(self) -> int:
+        self.now_consumed = True
+        return self._now
 
     # ------------------------------------------------------------------ types
     def infer_type(self, fn: str, arg_dtypes: list[DT]) -> DT:

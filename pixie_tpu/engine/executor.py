@@ -89,6 +89,19 @@ class GroupKeyFallback(Unimplemented):
 MIN_BUCKET = 1 << 10
 from pixie_tpu import flags as _flags
 
+# Persistent jit cache: with PX_JIT_CACHE_DIR set, XLA compilations persist
+# across processes (jax's compilation cache), so a restarted agent's first
+# interactive query warms from disk instead of paying a fresh XLA compile.
+_JIT_CACHE_DIR = _flags.define_str(
+    "PX_JIT_CACHE_DIR", "",
+    "directory for jax's persistent compilation cache (empty = off)")
+if _JIT_CACHE_DIR:
+    try:
+        jax.config.update("jax_compilation_cache_dir", _JIT_CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # older jax without the knobs: feature degrades silently
+        pass
+
 #: Feed coalescing target: sealed storage batches (64K-ish, the reference's
 #: compaction granularity) are merged into large device feeds so a typical
 #: query is ONE device execution.  Sized at 16M rows (~0.5 GB at 32 B/row)
@@ -136,10 +149,32 @@ def _cache_put(sig, value):
             _KERNEL_CACHE.popitem(last=False)
 
 
+#: op fields the streaming poller / matview maintainer PATCH between runs
+#: (stream.py: source since/stop row ids + carried limit budgets;
+#: maintainer.py: delta scan bounds) — everything else on a plan op is
+#: immutable after compile
+_VOLATILE_OP_FIELDS = ("n", "since_row_id", "stop_row_id")
+
+
 def _op_sig(op) -> dict:
-    d = op.to_dict()
-    d.pop("id", None)
-    return d
+    # Memoized on the op instance: plan ops are structurally immutable after
+    # compile, and warm interactive queries re-sign the same plan objects
+    # every few ms — re-walking the op/expression tree per query was
+    # measurable fast-path latency.  (copy.copy in the distributed planner
+    # carries the memo; only `id` changes there, and `id` is excluded.)
+    # The VOLATILE fields above are re-read live on every call: they are
+    # runtime-patched per poll, and a stale signature would let the chain
+    # cache serve a kernel with last poll's baked-in budget/scan bounds.
+    got = op.__dict__.get("_op_sig_cache")
+    if got is None:
+        d = op.to_dict()
+        d.pop("id", None)
+        op.__dict__["_op_sig_cache"] = got = d
+        return got
+    for f in _VOLATILE_OP_FIELDS:
+        if f in got:
+            got[f] = getattr(op, f)
+    return got
 
 
 #: blocking-op intermediates cache kernels by dictionary CONTENT; above this
@@ -914,24 +949,61 @@ class _FinalizedCol:
 _MERGE_FINALIZE_CACHE: dict = {}
 
 
+def _device_finalize_split(udas_by_name, finalize_ok: bool = True):
+    """state → (finals, rest) closure shared by the merge and fused paths:
+    device-finalizable outputs run finalize_device, the rest pass through
+    for the host finalize step."""
+    fin = {name: uda for name, uda in udas_by_name.items()
+           if finalize_ok and uda.device_finalize}
+
+    def split(state):
+        finals = {k: fin[k].finalize_device(state[k]) for k in fin}
+        rest = {k: v for k, v in state.items() if k not in fin}
+        return finals, rest
+
+    return split
+
+
 def _merge_finalize_fn(spec_key, reduce_tree, udas_by_name,
                        finalize_ok: bool = True):
     fn = _MERGE_FINALIZE_CACHE.get(spec_key)
     if fn is None:
         merge = ChainKernel.merge_states_fn(reduce_tree)
-        fin = {name: uda for name, uda in udas_by_name.items()
-               if finalize_ok and uda.device_finalize}
+        finalize = _device_finalize_split(udas_by_name, finalize_ok)
 
         def run(*states):
-            merged = merge(*states) if len(states) > 1 else states[0]
-            finals = {k: fin[k].finalize_device(merged[k]) for k in fin}
-            rest = {k: v for k, v in merged.items() if k not in fin}
-            return finals, rest
+            return finalize(merge(*states) if len(states) > 1 else states[0])
 
         fn = jax.jit(run)
         if len(_MERGE_FINALIZE_CACHE) > 64:
             _MERGE_FINALIZE_CACHE.clear()
         _MERGE_FINALIZE_CACHE[spec_key] = fn
+    return fn
+
+
+#: fused single-feed partial+finalize executions, keyed by the chain's cache
+#: sig (which pins the kernel's structure, dictionaries, and key sets)
+_FUSED_FINALIZE_CACHE: dict = {}
+
+
+def _fused_partial_finalize(fuse_key, udas_by_name, partial_step):
+    """ONE device execution for the single-feed warm query: the per-feed
+    partial update and the device finalize trace TOGETHER, so a forced-TPU
+    interactive query (1M rows = one coalesced feed) pays one execution +
+    one small readback wave instead of two chained executions — on tunneled
+    runtimes every execution bills a fixed ~100 ms RTT, so this is the
+    difference between sitting on the D2H wave-RTT floor and 2x it."""
+    fn = _FUSED_FINALIZE_CACHE.get(fuse_key)
+    if fn is None:
+        finalize = _device_finalize_split(udas_by_name)
+
+        def run(cols, n_valid, t_lo, t_hi, luts):
+            return finalize(partial_step(cols, n_valid, t_lo, t_hi, luts))
+
+        fn = jax.jit(run)
+        if len(_FUSED_FINALIZE_CACHE) > 64:
+            _FUSED_FINALIZE_CACHE.clear()
+        _FUSED_FINALIZE_CACHE[fuse_key] = fn
     return fn
 
 
@@ -1137,6 +1209,30 @@ class PlanExecutor:
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
     # ------------------------------------------------------------- stream feed
+    def _predicted_single_feed(self, src, cap) -> bool:
+        """Exact feed count from snapshot metadata (mirrors _feed's flush
+        logic: hot remainder flushes pending sealed rows; sealed rows
+        coalesce to the feed target).  Cursors are immutable snapshots, so
+        the prediction cannot be invalidated by concurrent writes."""
+        if isinstance(src, HostBatch):
+            return True
+        target = max(cap, FEED_ROWS)
+        feeds = pend_rows = 0
+        for rb, _row_id, gen in src:
+            n = rb.num_valid
+            if n == 0:
+                continue
+            if gen is None and pend_rows:
+                feeds += 1
+                pend_rows = 0
+            pend_rows += n
+            if pend_rows >= target:
+                feeds += 1
+                pend_rows = 0
+        if pend_rows:
+            feeds += 1
+        return feeds <= 1
+
     def _feed(self, src, names, cap, spmd: bool = False,
               backend: str = "tpu"):
         """Yield (cols np dict padded, n_valid) host batches.
@@ -1299,7 +1395,9 @@ class PlanExecutor:
                 key["md_epoch"] = _mdstate.global_manager().epoch
             return _json.dumps(key, sort_keys=True, default=str)
         table = self.store.table(head.table)
-        src_sig = _op_sig(head)
+        # _op_sig memoizes its dict on the op; copy before popping so the
+        # shared cache keeps its time bounds for include_times=True callers.
+        src_sig = dict(_op_sig(head))
         # Row-id bounds are pure runtime cursor state (streaming resume
         # tokens); kernels never bake them.
         src_sig.pop("since_row_id", None)
@@ -1910,7 +2008,7 @@ class PlanExecutor:
                     state_np = self._agg_feed_loop(
                         kern, step, partial_step, merge_fn, spmd_step,
                         init_specs, num_groups,
-                        src, names, cap, t_lo, t_hi, luts,
+                        src, names, cap, t_lo, t_hi, luts, fuse_key=sig,
                     )
                 self._feed_rec = None
         return keys, udas, state_np, seen_name, in_types, val_dicts
@@ -2032,7 +2130,7 @@ class PlanExecutor:
 
     def _agg_feed_loop(self, kern, step, partial_step, merge_fn, spmd_step,
                        init_specs, num_groups, src, names, cap, t_lo, t_hi,
-                       luts):
+                       luts, fuse_key=None):
         """Drive the feeds through the agg step and pull the final state.
 
         State init is LAZY: creating identity state eagerly would dispatch
@@ -2078,9 +2176,67 @@ class PlanExecutor:
             # GSPMD like any other consumer.
             device_merge_ok = (backend == "tpu"
                                and not getattr(self, "_defer_active", False))
+            # Single-feed fusion: when the snapshot metadata predicts exactly
+            # one feed (the interactive warm-query shape — 1M rows coalesce
+            # into one feed), the first feed is held back undispatched and
+            # partial+finalize run as ONE fused execution below instead of
+            # two chained ones.  Multi-feed queries never hold: the device
+            # would idle through the next feed's host-side assembly, undoing
+            # the compute/transfer overlap.  (The dispatch-on-second-arrival
+            # fallback in the loop stays as a safety net.)
+            fuse_ok = (fuse_key is not None and not self.analyze
+                       and spmd_step is None and device_merge_ok
+                       and not getattr(self, "_partial_wire", False)
+                       and self._predicted_single_feed(src, cap))
+            held = None
+
+            def dispatch_plain(cols, n_valid):
+                # A small NUMPY feed (typically the hot remainder of a
+                # big table) dispatches on CPU even in a TPU-routed
+                # query: it would otherwise cost one more fixed-price
+                # TPU execution; the host merge unifies the partials.
+                bucket = _first_len(cols)
+                first = next(iter(cols.values()))
+                small_np = (isinstance(first, np.ndarray)
+                            and bucket <= CPU_CROSSOVER_ROWS
+                            and _cpu_device() is not False)
+                if small_np and device_merge_ok:
+                    # A device-merged query keeps its small feeds (the
+                    # hot remainder) ON the accelerator: executions are
+                    # cheap async dispatches, while a CPU partial here
+                    # would force the mixed pull path — megabytes of
+                    # sketch state over the tunnel instead of one
+                    # device merge + a kilobyte readback.
+                    small_np = False
+                ctx = (jax.default_device(_cpu_device()) if small_np
+                       else _contextlib.nullcontext())
+                with ctx:
+                    p = partial_step(cols, np.int64(n_valid), t_lo,
+                                     t_hi, luts)
+                    if not small_np and backend == "tpu" \
+                            and not device_merge_ok \
+                            and not getattr(self, "_defer_active",
+                                            False):
+                        # pack the multi-leaf state into one buffer per
+                        # dtype (an extra async dispatch): each pulled
+                        # leaf costs a round trip on a tunneled runtime
+                        # (deferred partials stay raw — the gang merge
+                        # reduces leaf-wise)
+                        pk = _state_packer(p)
+                        if pk is not None:
+                            packer, unpack = pk
+                            p = _PackedState(packer(p), unpack)
+                partials.append(p)
+
             for cols, n_valid in self._feed(src, names, cap,
                                             spmd=spmd_step is not None,
                                             backend=backend):
+                if fuse_ok and held is None and not partials:
+                    held = (cols, n_valid)
+                    continue
+                if held is not None:
+                    dispatch_plain(*held)
+                    held = None
                 bucket = _first_len(cols)
                 if spmd_step is not None and bucket % n_dev == 0:
                     from pixie_tpu.parallel.spmd import per_shard_valid
@@ -2089,41 +2245,7 @@ class PlanExecutor:
                     partials.append(spmd_step(cols, nv, t_lo, t_hi, luts))
                     self.stats["spmd_feeds"] = self.stats.get("spmd_feeds", 0) + 1
                 else:
-                    # A small NUMPY feed (typically the hot remainder of a
-                    # big table) dispatches on CPU even in a TPU-routed
-                    # query: it would otherwise cost one more fixed-price
-                    # TPU execution; the host merge unifies the partials.
-                    first = next(iter(cols.values()))
-                    small_np = (isinstance(first, np.ndarray)
-                                and bucket <= CPU_CROSSOVER_ROWS
-                                and _cpu_device() is not False)
-                    if small_np and device_merge_ok:
-                        # A device-merged query keeps its small feeds (the
-                        # hot remainder) ON the accelerator: executions are
-                        # cheap async dispatches, while a CPU partial here
-                        # would force the mixed pull path — megabytes of
-                        # sketch state over the tunnel instead of one
-                        # device merge + a kilobyte readback.
-                        small_np = False
-                    ctx = (jax.default_device(_cpu_device()) if small_np
-                           else _contextlib.nullcontext())
-                    with ctx:
-                        p = partial_step(cols, np.int64(n_valid), t_lo,
-                                         t_hi, luts)
-                        if not small_np and backend == "tpu" \
-                                and not device_merge_ok \
-                                and not getattr(self, "_defer_active",
-                                                False):
-                            # pack the multi-leaf state into one buffer per
-                            # dtype (an extra async dispatch): each pulled
-                            # leaf costs a round trip on a tunneled runtime
-                            # (deferred partials stay raw — the gang merge
-                            # reduces leaf-wise)
-                            pk = _state_packer(p)
-                            if pk is not None:
-                                packer, unpack = pk
-                                p = _PackedState(packer(p), unpack)
-                    partials.append(p)
+                    dispatch_plain(cols, n_valid)
                 if self.analyze:
                     tf0 = _time.perf_counter_ns()
                     jax.block_until_ready(
@@ -2134,6 +2256,23 @@ class PlanExecutor:
                     if rec is not None:
                         rec.setdefault("feed_ns", []).append(
                             _time.perf_counter_ns() - tf0)
+            if held is not None:
+                # exactly ONE feed: the fused execution computes partial
+                # state AND finalizes on device in a single dispatch — one
+                # execution + one small readback wave is the whole query
+                fn = _fused_partial_finalize(
+                    fuse_key,
+                    {name: uda for name, uda, _dt in init_specs},
+                    partial_step)
+                finals, rest = fn(held[0], np.int64(held[1]), t_lo, t_hi,
+                                  luts)
+                finals_np, rest_np = transfer.pull((finals, rest))
+                self.stats["fused_single_feed"] = self.stats.get(
+                    "fused_single_feed", 0) + 1
+                out = dict(rest_np)
+                for k, v in finals_np.items():
+                    out[k] = _FinalizedCol(v)
+                return out
             if partials:
                 # deferral is scoped to the distributed partial path
                 # (_partial_agg_batch) — the local finalize path reads the

@@ -31,7 +31,8 @@ def _build() -> bool:
         if _SO_PATH.stat().st_mtime >= newest:
             return True
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(_SO_PATH),
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+        "-o", str(_SO_PATH),
         *[str(s) for s in srcs],
     ]
     try:

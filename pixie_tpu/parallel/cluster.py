@@ -129,6 +129,12 @@ class LocalCluster:
         )
         self.spec = ClusterSpec(agents)
         self.planner = DistributedPlanner(self.spec)
+        #: whole-query plan cache (PL_QUERY_FASTPATH) — the SAME contract as
+        #: the networked broker: warm repeated scripts skip re-trace/re-split
+        #: (engine/plancache.py documents the soundness argument)
+        from pixie_tpu.engine.plancache import QueryPlanCache
+
+        self.plan_cache = QueryPlanCache()
         #: per-agent tracepoint managers (created on first mutation)
         self._tp_managers: dict = {}
         #: per-agent standing-view maintainers (pixie_tpu.matview): repeated
@@ -173,19 +179,37 @@ class LocalCluster:
                 self._meshes[n] = make_mesh(n)
             return self._meshes[n]
 
+    def _schemas_fp(self) -> tuple:
+        """Schema fingerprint for the plan cache: per-store table-set epochs
+        (bumped by create/drop/tracepoint deploys).  Relations are immutable,
+        so the epochs pin the combined schema view exactly."""
+        return tuple(sorted((n, s.epoch) for n, s in self.stores.items()))
+
     def query(self, pxl_source: str, func: Optional[str] = None,
               func_args: Optional[dict] = None, now: Optional[int] = None,
               default_limit: Optional[int] = None,
               analyze: bool = False) -> dict[str, QueryResult]:
         """Compile a PxL script against the cluster's combined schemas and
-        execute it distributed (the ExecuteScript analog)."""
+        execute it distributed (the ExecuteScript analog).  Warm repeats of
+        the same script hit the whole-query plan cache and skip the compile
+        and distributed-split work entirely (bit-equal results — the cached
+        plan IS the plan a recompile would produce)."""
         from pixie_tpu.compiler import compile_pxl
+        from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
 
-        q = compile_pxl(pxl_source, self.schemas(), func=func, func_args=func_args,
-                        now=now, default_limit=default_limit)
+        fp = self._schemas_fp()
+        key = self.plan_cache.key(pxl_source, func, func_args, default_limit,
+                                  fp)
+        q, entry, _hit = self.plan_cache.get_query(
+            key, lambda: compile_pxl(pxl_source, self.schemas(), func=func,
+                                     func_args=func_args, now=now,
+                                     default_limit=default_limit,
+                                     registry=self.registry))
         if q.mutations:
             self.apply_mutations(q.mutations)
-        return self.execute(q.plan, analyze=analyze)
+        (dp, _extras), _shit = _QPC.get_split(
+            entry, fp, lambda: (self.planner.plan(q.plan), {}))
+        return self.execute(q.plan, analyze=analyze, dp=dp)
 
     def apply_mutations(self, mutations: list) -> None:
         """Deploy tracepoints on every data agent and refresh the planner's
@@ -202,8 +226,10 @@ class LocalCluster:
             if a.name in self.stores:
                 a.schemas = self.stores[a.name].schemas()
 
-    def execute(self, logical: Plan, analyze: bool = False) -> dict[str, QueryResult]:
-        dp = self.planner.plan(logical)
+    def execute(self, logical: Plan, analyze: bool = False,
+                dp=None) -> dict[str, QueryResult]:
+        if dp is None:
+            dp = self.planner.plan(logical)
 
         # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
         #    each SPMD over the agent's device mesh (AgentInfo.n_devices).

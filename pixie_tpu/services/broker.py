@@ -15,6 +15,7 @@ locally, and stream result chunks back to the client.
 """
 from __future__ import annotations
 
+import json as _json
 import threading
 import traceback
 from typing import Optional
@@ -170,6 +171,12 @@ class Broker:
         self.udf_registry = registry
         self.query_timeout_s = query_timeout_s
         self.merger_store = TableStore()
+        #: whole-query plan cache (PL_QUERY_FASTPATH): warm dashboard
+        #: queries skip re-trace/re-optimize/re-split/re-serialize — see
+        #: engine/plancache.py for the soundness argument
+        from pixie_tpu.engine.plancache import QueryPlanCache
+
+        self.plan_cache = QueryPlanCache()
         #: self-telemetry spans for the query path; shipped to an agent's
         #: spans table at query end (the broker holds no scanned store)
         self.tracer = trace.Tracer("broker")
@@ -680,24 +687,41 @@ class Broker:
             leader = self.elector.leader()
             raise Unavailable(
                 f"this broker is not the leader (current leader: {leader})")
+        # Epoch BEFORE cluster_spec: a registration landing between the two
+        # reads must not let a split computed from the agent-less spec be
+        # cached under the post-registration epoch (sticky wrong results).
+        # The inverse race — cluster_spec's live_agents() expiring an agent
+        # and bumping the epoch after our read — only caches the fresh split
+        # under the stale epoch: one redundant miss, never a poisoned hit.
+        topo_epoch = self.registry.epoch
         spec = self.registry.cluster_spec()
         if not any(a.has_data_store for a in spec.agents):
             raise Unavailable("no live data agents registered")
         sink_map = None
-        with trace.span("compile"):
-            if funcs:
+        entry = None
+        plan_cache_hit = False
+        if funcs:
+            # multi-widget fusion stays on the slow path: its sink_map and
+            # per-widget arg sets make the cache key explode for no warm win
+            with trace.span("compile"):
                 q, sink_map = compile_pxl_funcs(
                     script, self.registry.combined_schemas(),
                     [(p, f, a) for p, f, a in funcs],
                     registry=self.udf_registry, now=now,
                     default_limit=default_limit,
                 )
-            else:
-                q = compile_pxl(
-                    script, self.registry.combined_schemas(), func=func,
-                    func_args=func_args, registry=self.udf_registry, now=now,
-                    default_limit=default_limit,
-                )
+        else:
+            def _compile():
+                with trace.span("compile"):
+                    return compile_pxl(
+                        script, self.registry.combined_schemas(), func=func,
+                        func_args=func_args, registry=self.udf_registry,
+                        now=now, default_limit=default_limit,
+                    )
+
+            key = self.plan_cache.key(script, func, func_args, default_limit,
+                                      ("reg", topo_epoch))
+            q, entry, plan_cache_hit = self.plan_cache.get_query(key, _compile)
         if q.mutations:
             # Deploy tracepoints to every live agent and wait for readiness
             # (reference MutationExecutor: register → agents deploy → poll
@@ -705,9 +729,25 @@ class Broker:
             with trace.span("deploy_mutations"):
                 self.tracepoints.apply(q.mutations)
                 self._deploy_mutations(q.mutations)
+            topo_epoch = self.registry.epoch  # BEFORE cluster_spec (see above)
             spec = self.registry.cluster_spec()  # schemas refreshed by re-register
-        with trace.span("plan_split"):
-            dp = DistributedPlanner(spec).plan(q.plan)
+
+        def _split():
+            with trace.span("plan_split"):
+                dp = DistributedPlanner(spec).plan(q.plan)
+                # pre-serialize the per-agent plan dicts: the dispatch loop
+                # splices these cached JSON fragments into each execute
+                # frame instead of re-walking + re-dumping the plan per query
+                extras = {"plan_json": {
+                    a: _json.dumps(p.to_dict())
+                    for a, p in dp.agent_plans.items()
+                }}
+                return dp, extras
+
+        from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
+
+        (dp, split_extras), split_hit = _QPC.get_split(
+            entry, ("split", topo_epoch), _split)
 
         reg = self.udf_registry
         if reg is None:
@@ -751,15 +791,23 @@ class Broker:
                 if dsp is not None:
                     ctx.dispatch_spans[agent_name] = dsp
                     tctx = {"trace_id": dsp.trace_id, "span_id": dsp.span_id}
-                conn.send(wire.encode_json({
+                meta = {
                     "msg": "execute", "req_id": req_id,
                     "qtoken": ctx.token,
                     "trace": tctx,
-                    "plan": plan.to_dict(), "analyze": analyze,
+                    "analyze": analyze,
                     # distributed fan-out: agents route CPU/TPU by the
                     # query's total size, not their local shard's
                     "route_scale": len(dp.agent_plans),
-                }))
+                }
+                # splice the cached plan JSON (encoded once per plan/split,
+                # not per query) instead of re-serializing the plan dict
+                pj = split_extras["plan_json"].get(agent_name)
+                if pj is not None:
+                    conn.send(wire.encode_json_raw(meta, {"plan": pj}))
+                else:  # pragma: no cover — split always covers its agents
+                    meta["plan"] = plan.to_dict()
+                    conn.send(wire.encode_json(meta))
             if dp.agent_plans and not ctx.done.wait(timeout=self.query_timeout_s):
                 raise Unavailable(
                     f"query timed out after {self.query_timeout_s}s waiting for "
@@ -834,6 +882,10 @@ class Broker:
                 for r in results.values():
                     restamp_result(r, q.plan, sstore, reg)
                 stats = {"agents": ctx.agent_stats, "merger": dict(ex.stats)}
+                #: fast-path observability: did this query skip compile /
+                #: split work?  (PL_QUERY_FASTPATH off ⇒ both always False)
+                stats["fastpath"] = {"plan_cache_hit": plan_cache_hit,
+                                     "split_cache_hit": split_hit}
                 if mv_keys:
                     served = {
                         a: s["matview"] for a, s in ctx.agent_stats.items()

@@ -36,6 +36,11 @@ class AgentRegistry:
         self._agents: dict[str, AgentRecord] = {}
         self._next_asid = 1
         self._lock = threading.Lock()
+        #: topology/schema epoch: bumped on every liveness or schema change
+        #: ((re-)register, death, expiry).  The broker's plan cache keys its
+        #: compiled queries and distributed splits on this, so a changed
+        #: cluster view can never serve a stale plan.
+        self.epoch = 0
         # Recall durable records (dead until they heartbeat again).
         for key, raw in self.kv.scan("agent/"):
             import json
@@ -57,6 +62,7 @@ class AgentRegistry:
         """(Re-)register an agent; returns its ASID."""
         now = time.monotonic()
         with self._lock:
+            self.epoch += 1
             rec = self._agents.get(name)
             if rec is None:
                 rec = AgentRecord(name, self._next_asid, schemas, n_devices, now)
@@ -95,6 +101,8 @@ class AgentRegistry:
         with self._lock:
             rec = self._agents.get(name)
             if rec is not None:
+                if rec.alive:
+                    self.epoch += 1
                 rec.alive = False
 
     def expire(self) -> list[str]:
@@ -106,6 +114,8 @@ class AgentRegistry:
                 if rec.alive and now - rec.last_heartbeat > self.expiry_s:
                     rec.alive = False
                     out.append(rec.name)
+            if out:
+                self.epoch += 1
         return out
 
     # ------------------------------------------------------------------- views

@@ -152,7 +152,12 @@ def _frame(kind: str, meta: dict, bufs: list[tuple[str, np.ndarray]]) -> bytes:
         s = _norm_dtype(arr.dtype)
         if s not in _ALLOWED_DTYPES:
             raise InvalidArgument(f"wire: dtype {s} of buffer {name!r} not allowed")
-        raw = arr.tobytes()
+        # Zero-copy column handoff: a read-only memoryview over the array's
+        # own bytes (tobytes() would materialize an intermediate copy of
+        # every result column per query); the single copy happens once, in
+        # the final join that builds the frame.  Empty arrays can't cast
+        # (zeros in shape/strides) — their tobytes() is free anyway.
+        raw = memoryview(arr).cast("B") if arr.size else arr.tobytes()
         table.append({"name": name, "dtype": s, "shape": list(arr.shape),
                       "nbytes": len(raw)})
         chunks.append(raw)
@@ -181,6 +186,29 @@ def _frame(kind: str, meta: dict, bufs: list[tuple[str, np.ndarray]]) -> bytes:
 
 def encode_json(meta: dict) -> bytes:
     return _frame("json", meta, [])
+
+
+def encode_json_raw(meta: dict, raw_fields: dict[str, str]) -> bytes:
+    """encode_json with PRE-SERIALIZED JSON values spliced in as extra
+    top-level meta keys.
+
+    The broker's warm-query dispatch caches each agent plan's JSON once per
+    compiled split; re-running json.dumps over the whole plan dict on every
+    query was measurable interactive latency.  The decoder is unchanged —
+    the spliced frame is byte-for-byte a normal json frame.
+    """
+    for k in raw_fields:
+        if k in meta:
+            raise InvalidArgument(f"wire: raw field {k!r} collides with meta")
+    meta_json = json.dumps(meta)
+    items = ",".join(f"{json.dumps(k)}:{v}" for k, v in raw_fields.items())
+    if items:
+        merged = (f"{{{items}}}" if meta_json == "{}"
+                  else f"{meta_json[:-1]},{items}}}")
+    else:
+        merged = meta_json
+    header = (f'{{"kind":"json","meta":{merged},"bufs":[]}}').encode()
+    return b"".join([_HDR.pack(MAGIC, len(header)), header])
 
 
 def _u128_jsonable(v):

@@ -85,6 +85,14 @@ class Table:
         self._sealed_bytes = 0
         self._expired_batches = 0
         self._total_rows_written = 0
+        #: cached full-table snapshot (the interactive warm-query fast path):
+        #: (version, Cursor).  A warm dashboard query re-snapshots the same
+        #: unchanged table every few ms; rebuilding the Cursor re-lists the
+        #: sealed batches and re-concatenates the hot rows each time.  The
+        #: version key covers every way the snapshot can change — appended
+        #: rows/seals (_next_row_id, _hot_rows) and retention trimming
+        #: (_expired_batches) — so a stale snapshot is unreachable.
+        self._snap_cache: tuple | None = None
 
     # ------------------------------------------------------------------ write
     def write(self, data: dict) -> int:
@@ -187,10 +195,17 @@ class Table:
     def _expire_locked(self):
         # Ring-buffer semantics: oldest sealed batches fall off when over budget
         # (reference table.h expiry by table_size_limit).
+        expired = False
         while self._sealed and self._sealed_bytes + self._hot_bytes_locked() > self.max_bytes:
             sb = self._sealed.pop(0)
             self._sealed_bytes -= sb.nbytes
             self._expired_batches += 1
+            expired = True
+        if expired:
+            # The cached snapshot still references every popped batch; drop
+            # it now (not at the next cursor() call, which may never come for
+            # an idle-but-written table) so expiry actually frees the memory.
+            self._snap_cache = None
 
     def _hot_bytes_locked(self) -> int:
         return sum(a.nbytes for arrs in self._hot.values() for a in arrs)
@@ -202,15 +217,35 @@ class Table:
         stop_time: int | None = None,
         include_hot: bool = True,
     ) -> "Cursor":
-        """Snapshot cursor over sealed batches (+ a padded snapshot of hot rows)."""
+        """Snapshot cursor over sealed batches (+ a padded snapshot of hot rows).
+
+        The unbounded full-table snapshot (the shape every warm interactive
+        query takes) is cached per table version: repeat queries over an
+        unchanged table reuse ONE immutable Cursor object instead of
+        re-listing batches and re-merging hot rows per query.  Time-bounded
+        cursors are not cached (relative ranges change every call).
+        """
+        cacheable = start_time is None and stop_time is None and include_hot
         with self._lock:
+            if cacheable:
+                version = (self._next_row_id, self._hot_rows,
+                           self._expired_batches)
+                if self._snap_cache is not None \
+                        and self._snap_cache[0] == version:
+                    return self._snap_cache[1]
             sealed = list(self._sealed)
             hot = None
             if include_hot and self._hot_rows > 0:
                 merged = self._take_hot_locked()
                 hot = RowBatch(self.relation, merged)
             hot_row_id = self._next_row_id
-        return Cursor(self, sealed, hot, hot_row_id, start_time, stop_time)
+        cur = Cursor(self, sealed, hot, hot_row_id, start_time, stop_time)
+        if cacheable:
+            with self._lock:
+                if (self._next_row_id, self._hot_rows,
+                        self._expired_batches) == version:
+                    self._snap_cache = (version, cur)
+        return cur
 
     def last_row_id(self) -> int:
         """Row id one past the newest row (streaming resume token source)."""
@@ -389,6 +424,11 @@ class TableStore:
     def __init__(self):
         self._tables: dict[str, Table] = {}
         self._lock = threading.Lock()
+        #: schema epoch: bumped whenever the table SET changes (create/drop/
+        #: add_table).  Compiled-plan caches key on this — a tracepoint
+        #: deploying a new table must miss every plan compiled before it.
+        #: Relations themselves are immutable, so the set is the schema.
+        self.epoch = 0
 
     def create(self, name: str, relation: Relation, tablet_col: str | None = None, **kw):
         """Create a Table, or a TabletsGroup when tablet_col is given
@@ -403,15 +443,18 @@ class TableStore:
             else:
                 t = Table(name, relation, **kw)
             self._tables[name] = t
+            self.epoch += 1
             return t
 
     def add_table(self, table: Table):
         with self._lock:
             self._tables[table.name] = table
+            self.epoch += 1
 
     def drop(self, name: str) -> None:
         with self._lock:
-            self._tables.pop(name, None)
+            if self._tables.pop(name, None) is not None:
+                self.epoch += 1
 
     def table(self, name: str) -> Table:
         t = self._tables.get(name)

@@ -81,6 +81,37 @@ def test_latency_tolerates_improvement_and_shape_mismatch():
     assert bench.compare_bench(_doc(), now, threshold=0.15) == []
 
 
+def test_ingest_shape_matching_old_and_new_docs():
+    """r06 records the ingest shape (`rows`); pre-r06 docs didn't — the
+    guard assumes the full-run 32M shape for those, so the ingest point
+    stays guarded ACROSS the key addition instead of silently unmatched."""
+    prior = _doc()  # pre-r06 shape: no rows key on ingest_microbench
+    assert bench.bench_points(prior)["configs.ingest_microbench"] == (
+        22_000_000, 32_000_000)
+    now = _doc(ingest=15_000_000)
+    now["configs"]["ingest_microbench"]["rows"] = 32_000_000
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert "configs.ingest_microbench" in [r["key"] for r in regs]
+    # a --quick run ingests fewer rows: different shape, no comparison
+    now["configs"]["ingest_microbench"]["rows"] = 4_000_000
+    assert bench.compare_bench(prior, now, threshold=0.15) == []
+
+
+def test_rtt_floor_is_environmental_not_a_latency_point():
+    """wave_rtt_floor_ms measures the ENVIRONMENT (tunnel RTT), not the
+    code: a noisier box must not read as a latency regression, and the
+    forced-TPU p50 keeps its own guard besides the floor ratio."""
+    prior = _doc()
+    prior["configs"]["interactive_1m"]["wave_rtt_floor_ms"] = 95.0
+    prior["configs"]["interactive_1m"]["tpu_path_vs_rtt_floor"] = 1.2
+    pts = bench.bench_latency_points(prior)
+    assert not any("floor" in k for k in pts)
+    assert "configs.interactive_1m.tpu_path_p50_ms" in pts
+    now = _doc()
+    now["configs"]["interactive_1m"]["wave_rtt_floor_ms"] = 300.0
+    assert bench.compare_bench(prior, now, threshold=0.15) == []
+
+
 def test_check_regressions_cli_paths(tmp_path, capsys):
     """File mode: a doc with a dropped config fails (exit 1) against the
     repo's prior BENCH round; the prior round's own numbers pass (exit 0)."""

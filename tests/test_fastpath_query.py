@@ -1,0 +1,326 @@
+"""Interactive fast path (PL_QUERY_FASTPATH whole-query plan cache).
+
+ISSUE-4 coverage matrix: cache-hit results bit-equal to cache-miss
+(including string/dictionary columns), invalidation on script text / param /
+schema-epoch / retention-trim change, fastpath-off equivalence, now-sensitive
+plans never cached, and concurrent warm queries through both the networked
+broker and LocalCluster.  Aggregates are integer-exact (count/sum/min/max)
+so bit-equality is well-defined; the string group key exercises the
+dictionary-column path end to end.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from pixie_tpu import flags
+from pixie_tpu.engine.plancache import QueryPlanCache
+from pixie_tpu.matview import MatViewManager as _MatViewManager  # noqa: F401
+# (import registers PL_MATVIEW_ENABLED so the fixture can disable it)
+from pixie_tpu.parallel.cluster import LocalCluster
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+REL = Relation.of(
+    ("time_", DT.TIME64NS), ("service", DT.STRING),
+    ("latency", DT.FLOAT64), ("status", DT.INT64),
+)
+
+SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 500]
+df = df.groupby('service').agg(
+    cnt=('latency', px.count), s=('latency', px.sum),
+    lo=('latency', px.min), hi=('latency', px.max))
+px.display(df, 'out')
+"""
+
+#: same shape, different text — must occupy a separate cache entry
+SCRIPT2 = SCRIPT.replace("status == 500", "status == 200")
+
+FUNC_SCRIPT = """
+def main(code: int):
+    df = px.DataFrame(table='http_events')
+    df = df[df.status == code]
+    df = df.groupby('service').agg(cnt=('latency', px.count))
+    px.display(df, 'out')
+"""
+
+
+@pytest.fixture(autouse=True)
+def _fastpath_on():
+    # matview off so warm-vs-cold equality isolates the PLAN cache
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    flags.set_for_testing("PL_QUERY_FASTPATH", True)
+    yield
+    flags.set_for_testing("PL_MATVIEW_ENABLED", True)
+    flags.set_for_testing("PL_QUERY_FASTPATH", True)
+
+
+def _write(t, n, seed, t0=0):
+    rng = np.random.default_rng(seed)
+    t.write({
+        "time_": np.arange(t0, t0 + n, dtype=np.int64) * 1000,
+        "service": rng.choice(["cart", "auth", "web"], n).tolist(),
+        "latency": rng.integers(0, 1000, n).astype(np.float64),
+        "status": rng.choice([200, 500], n),
+    })
+
+
+def _mkstore(seed, n=20_000, **kw):
+    ts = TableStore()
+    t = ts.create("http_events", REL, batch_rows=4096, **kw)
+    _write(t, n, seed)
+    return ts
+
+
+def _bit_equal(a, b):
+    """Column-level bitwise equality, dictionary columns decoded (codes may
+    legally differ between dictionaries; the VALUES must not)."""
+    assert a.relation.names() == b.relation.names()
+    for name in a.relation.names():
+        ca, cb = a.decoded(name), b.decoded(name)
+        if isinstance(ca, np.ndarray):
+            assert ca.dtype == cb.dtype, name
+            assert np.array_equal(ca, cb), name
+        else:
+            assert ca == cb, name
+
+
+def _sorted_rows(res):
+    recs = res.to_records()
+    return sorted(recs, key=lambda r: tuple(str(r[k]) for k in sorted(r)))
+
+
+# ----------------------------------------------------------- local cluster
+
+
+def test_cache_hit_bit_equal_to_miss_with_string_columns():
+    cluster = LocalCluster({"pem0": _mkstore(1)})
+    cold = cluster.query(SCRIPT)["out"]          # miss: compiles
+    assert cluster.plan_cache.misses == 1
+    warm = cluster.query(SCRIPT)["out"]          # hit: cached plan + split
+    assert cluster.plan_cache.hits >= 1
+    assert warm.num_rows > 0
+    _bit_equal(cold, warm)
+    # string group key really went through a dictionary
+    assert "service" in warm.dictionaries
+
+
+def test_invalidation_on_script_text_change():
+    cluster = LocalCluster({"pem0": _mkstore(2)})
+    a = cluster.query(SCRIPT)["out"]
+    misses = cluster.plan_cache.misses
+    b = cluster.query(SCRIPT2)["out"]            # different text -> miss
+    assert cluster.plan_cache.misses == misses + 1
+    # and the two scripts really computed different things
+    assert _sorted_rows(a) != _sorted_rows(b)
+
+
+def test_invalidation_on_param_change():
+    cluster = LocalCluster({"pem0": _mkstore(3)})
+    a = cluster.query(FUNC_SCRIPT, func="main", func_args={"code": 500})["out"]
+    misses = cluster.plan_cache.misses
+    a2 = cluster.query(FUNC_SCRIPT, func="main", func_args={"code": 500})["out"]
+    assert cluster.plan_cache.misses == misses  # same params -> hit
+    _bit_equal(a, a2)
+    b = cluster.query(FUNC_SCRIPT, func="main", func_args={"code": 200})["out"]
+    assert cluster.plan_cache.misses == misses + 1  # new params -> miss
+    assert _sorted_rows(a) != _sorted_rows(b)
+
+
+def test_invalidation_on_schema_epoch_change():
+    ts = _mkstore(4)
+    cluster = LocalCluster({"pem0": ts})
+    cluster.query(SCRIPT)
+    misses = cluster.plan_cache.misses
+    cluster.query(SCRIPT)
+    assert cluster.plan_cache.misses == misses  # warm
+    # table-set change bumps TableStore.epoch -> fingerprint miss
+    ts.create("other", Relation.of(("x", DT.INT64)))
+    cluster.query(SCRIPT)
+    assert cluster.plan_cache.misses == misses + 1
+
+
+def test_warm_results_track_new_writes_and_retention_trim():
+    """The plan cache must never freeze DATA: appended rows show up in the
+    next warm run, and retention trimming (evicted sealed batches) drops
+    out — the cursor snapshot cache keys on both."""
+    ts = TableStore()
+    # tiny byte budget: early batches get trimmed as later ones seal
+    t = ts.create("http_events", REL, batch_rows=1024, max_bytes=1 << 18)
+    _write(t, 4_000, 5)
+    cluster = LocalCluster({"pem0": ts})
+    first = cluster.query(SCRIPT)["out"]
+    # appended rows: warm re-run reflects them
+    _write(t, 4_000, 6, t0=4_000)
+    second = cluster.query(SCRIPT)["out"]
+    # trim-inducing writes: cursor must rebuild past the trimmed batches
+    _write(t, 50_000, 7, t0=8_000)
+    third = cluster.query(SCRIPT)["out"]
+    # oracle: fresh cluster (cold compile, fresh snapshot) on the SAME store
+    oracle = LocalCluster({"pem0": ts}).query(SCRIPT)["out"]
+    _bit_equal(third, oracle)
+    assert t._expired_batches > 0  # the trim actually happened
+    assert _sorted_rows(first) != _sorted_rows(second)
+
+
+def test_fastpath_off_identical_results():
+    ts = _mkstore(8)
+    warm_cluster = LocalCluster({"pem0": ts})
+    warm_cluster.query(SCRIPT)
+    warm = warm_cluster.query(SCRIPT)["out"]
+    flags.set_for_testing("PL_QUERY_FASTPATH", False)
+    off_cluster = LocalCluster({"pem0": ts})
+    off_cluster.query(SCRIPT)
+    off = off_cluster.query(SCRIPT)["out"]
+    assert off_cluster.plan_cache.hits == 0
+    _bit_equal(warm, off)
+
+
+def test_now_sensitive_plans_never_cached():
+    """Relative time ranges bake `now` into the plan — caching one would
+    silently reuse a stale timestamp on every later dashboard refresh."""
+    ts = _mkstore(9)
+    cluster = LocalCluster({"pem0": ts})
+    script = SCRIPT.replace(
+        "px.DataFrame(table='http_events')",
+        "px.DataFrame(table='http_events', start_time='-5m')")
+    cluster.query(script, now=10**15)
+    cluster.query(script, now=10**15)
+    assert cluster.plan_cache.hits == 0
+
+
+def test_concurrent_warm_queries_local_cluster():
+    cluster = LocalCluster({"pem0": _mkstore(10), "pem1": _mkstore(11)})
+    oracle = cluster.query(SCRIPT)["out"]
+    results, errors = [], []
+
+    def run():
+        try:
+            results.append(cluster.query(SCRIPT)["out"])
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    assert len(results) == 8
+    for r in results:
+        _bit_equal(oracle, r)
+    assert cluster.plan_cache.hits >= 8
+
+
+# ----------------------------------------------------------------- broker
+
+
+def _broker_pair(stores):
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=30.0).start()
+    agents = [
+        Agent(name, "127.0.0.1", broker.port, store=st, heartbeat_s=0.2).start()
+        for name, st in stores.items()
+    ]
+    return broker, agents
+
+
+def test_broker_fastpath_hit_bit_equal_and_flagged():
+    broker, agents = _broker_pair({"pem1": _mkstore(12)})
+    try:
+        cold, stats0 = broker.execute_script(SCRIPT)
+        assert stats0["fastpath"] == {"plan_cache_hit": False,
+                                      "split_cache_hit": False}
+        warm, stats1 = broker.execute_script(SCRIPT)
+        assert stats1["fastpath"] == {"plan_cache_hit": True,
+                                      "split_cache_hit": True}
+        _bit_equal(cold["out"], warm["out"])
+    finally:
+        for a in agents:
+            a.stop()
+        broker.stop()
+
+
+def test_broker_topology_change_invalidates_split():
+    broker, agents = _broker_pair({"pem1": _mkstore(13)})
+    try:
+        broker.execute_script(SCRIPT)
+        _res, stats = broker.execute_script(SCRIPT)
+        assert stats["fastpath"]["plan_cache_hit"]
+        # a new agent bumps the registry epoch: the cached per-agent split
+        # no longer matches the cluster and must be re-planned
+        from pixie_tpu.services.agent import Agent
+
+        extra = Agent("pem2", "127.0.0.1", broker.port, store=_mkstore(14),
+                      heartbeat_s=0.2).start()
+        agents.append(extra)
+        deadline = 50
+        while broker.registry.live_agents() is not None and deadline:
+            if any(a.name == "pem2" for a in broker.registry.live_agents()):
+                break
+            import time
+
+            time.sleep(0.1)
+            deadline -= 1
+        res, stats2 = broker.execute_script(SCRIPT)
+        assert not stats2["fastpath"]["plan_cache_hit"]
+        assert res["out"].num_rows > 0
+    finally:
+        for a in agents:
+            a.stop()
+        broker.stop()
+
+
+def test_concurrent_warm_queries_broker():
+    broker, agents = _broker_pair({"pem1": _mkstore(15)})
+    try:
+        oracle, _ = broker.execute_script(SCRIPT)
+        results, errors = [], []
+
+        def run():
+            try:
+                results.append(broker.execute_script(SCRIPT)[0]["out"])
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=run) for _ in range(6)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errors
+        for r in results:
+            _bit_equal(oracle["out"], r)
+    finally:
+        for a in agents:
+            a.stop()
+        broker.stop()
+
+
+# ------------------------------------------------------------- cache unit
+
+
+def test_plan_cache_lru_bounded():
+    cache = QueryPlanCache(max_entries=4)
+
+    class Q:
+        now_sensitive = False
+        mutations = ()
+
+    for i in range(10):
+        key = cache.key(f"script{i}", None, None, None, ("fp", 0))
+        cache.get_query(key, lambda: Q())
+    assert len(cache._entries) == 4
+
+
+def test_plan_cache_key_distinguishes_args():
+    k1 = QueryPlanCache.key("s", "main", {"a": 1}, None, ("fp", 0))
+    k2 = QueryPlanCache.key("s", "main", {"a": 2}, None, ("fp", 0))
+    k3 = QueryPlanCache.key("s", "main", {"a": 1}, None, ("fp", 1))
+    assert len({k1, k2, k3}) == 3
