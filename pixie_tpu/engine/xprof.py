@@ -272,10 +272,22 @@ class _StateSampler:
         import threading
 
         self._stop = threading.Event()
+        all_threads = self.tids == ["*"]
 
         def loop():
-            paths = [f"/proc/self/task/{t}/stat" for t in self.tids]
+            me = threading.get_native_id()
+            if all_threads:
+                paths = None
+            else:
+                paths = [f"/proc/self/task/{t}/stat" for t in self.tids]
             while not self._stop.is_set():
+                if all_threads:
+                    # refresh per sample: native kernels spawn short-lived
+                    # workers; exclude the sampler thread itself (it is R
+                    # while reading /proc and would count as always-busy)
+                    paths = [f"/proc/self/task/{t}/stat"
+                             for t in os.listdir("/proc/self/task")
+                             if t != str(me)]
                 running = False
                 for p in paths:
                     try:
@@ -304,6 +316,45 @@ def cpu_pool_sampler() -> "_StateSampler":
     its whole replay loop; `fn`-shaped callers use the measure functions).
     Read `.busy`/`.total` after exit."""
     return _StateSampler(_xla_pool_tids())
+
+
+def process_busy_sampler() -> "_StateSampler":
+    """Context-manager sampler over EVERY thread of this process (tids
+    refreshed per sample via the '*' sentinel).  For kernels whose compute
+    does not run on the XLA pool — the native CPU join's pthread workers —
+    where the XLA-pool sampler would report idle while the cores burn."""
+    return _StateSampler(["*"])
+
+
+def measure_process_busy(fn) -> dict:
+    """Occupancy of fn() counting ANY process thread in run state — the
+    honest busy measure for native (non-XLA) kernels on the CPU device.
+
+    Semantic (same contract as the XLA-pool sampler): the fraction of wall
+    time with AT LEAST ONE thread running — occupancy, not core
+    utilization; it cannot distinguish 1 busy worker from 8.  The caller
+    thread counts too: during a native kernel it is either blocked in the
+    extension call (S state, not sampled busy) or doing the kernel's own
+    host-side glue (buffer alloc, mask scatters), which IS part of the
+    kernel's wall and would be idle time if unsampled."""
+    import jax
+
+    with process_busy_sampler() as s:
+        t0 = time.perf_counter()
+        out = fn()
+        try:
+            jax.block_until_ready(out)
+        except Exception:
+            pass
+        wall_s = time.perf_counter() - t0
+    frac = s.busy / s.total if s.total else 0.0
+    return {
+        "device_busy_frac": round(frac, 3),
+        "busy_ms": round(frac * wall_s * 1000, 1),
+        "wall_ms": round(wall_s * 1000, 1),
+        "source": "proc_sampled",
+        "_debug": {"busy_samples": s.busy, "total_samples": s.total},
+    }
 
 
 def measure_device_busy_sampled(fn) -> dict:

@@ -72,5 +72,18 @@ def load_native():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
         ]
         lib.px_dict_insert_ucs4.restype = ctypes.c_int32
+        # radix hash join (native/join.cc) — guard with hasattr so a stale
+        # .so built before the kernel existed degrades to the XLA path
+        # instead of raising at load time
+        if hasattr(lib, "px_join_run"):
+            lib.px_join_run.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_void_p,
+            ]
+            lib.px_join_run.restype = ctypes.c_void_p
+            lib.px_join_fetch.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.px_join_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib

@@ -97,6 +97,32 @@ def test_ingest_shape_matching_old_and_new_docs():
     assert bench.compare_bench(prior, now, threshold=0.15) == []
 
 
+def test_mfu_and_device_join_points_guarded():
+    """r06's new rate points: mxu_est.mfu_vs_peak and the device-join unit
+    bench (rows-keyed) fail the guard on >15% drops — the device-kernel
+    efficiency work must not silently regress (ISSUE-5 satellite)."""
+    prior = _doc()
+    prior["mxu_est"] = {"achieved_flops_per_sec": 2.2e13,
+                        "mfu_vs_peak": 0.11}
+    prior["configs"]["device_join_unit"] = {
+        "rows_per_sec": 11_000_000, "rows": 16_000_000, "path": "native_cpu"}
+    pts = bench.bench_points(prior)
+    assert pts["mxu_est.mfu_vs_peak"] == (0.11, 64_000_000)
+    assert pts["configs.device_join_unit"] == (11_000_000, 16_000_000)
+
+    now = json.loads(json.dumps(prior))
+    now["mxu_est"]["mfu_vs_peak"] = 0.08  # -27%
+    now["configs"]["device_join_unit"]["rows_per_sec"] = 8_000_000  # -27%
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert {r["key"] for r in regs} == {"mxu_est.mfu_vs_peak",
+                                        "configs.device_join_unit"}
+    # pre-r06 prior (agg-only model, no mfu point / no join rows key):
+    # the new-model numbers must NOT compare against the old model's
+    old = _doc()
+    old["configs"]["device_join_unit"] = {"rows_per_sec": 868_456}
+    assert bench.compare_bench(old, now, threshold=0.15) == []
+
+
 def test_rtt_floor_is_environmental_not_a_latency_point():
     """wave_rtt_floor_ms measures the ENVIRONMENT (tunnel RTT), not the
     code: a noisier box must not read as a latency regression, and the
