@@ -138,3 +138,41 @@ class QueryPlanCache:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+
+
+class NativeProgramCache:
+    """Lowered whole-plan micro-programs (native/codegen.py) keyed by the
+    executor's chain cache signature — the same key that pins the jitted
+    kernel bundle, so a cached program can never outlive the kernel whose
+    LUT names and key layout it references.  `None` results are cached too:
+    an ineligible plan must not pay the lowering walk on every query."""
+
+    MAX = 256
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+
+    def get_or_lower(self, sig, lower_fn):
+        """→ lowered program or None.  Uncacheable signatures (sig None)
+        lower fresh every call — the walk is cheap relative to a query."""
+        if sig is None:
+            return lower_fn()
+        with self._lock:
+            if sig in self._entries:
+                self._entries.move_to_end(sig)
+                return self._entries[sig]
+        prog = lower_fn()
+        with self._lock:
+            self._entries[sig] = prog
+            while len(self._entries) > self.MAX:
+                self._entries.popitem(last=False)
+        return prog
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+#: process-wide program cache (programs are structural — no per-store data)
+native_programs = NativeProgramCache()

@@ -75,6 +75,11 @@ def load_native():
         # radix hash join (native/join.cc) — guard with hasattr so a stale
         # .so built before the kernel existed degrades to the XLA path
         # instead of raising at load time
+        # whole-plan fused loop (native/wholeplan.cc) — args are passed as
+        # explicit ctypes objects by codegen.py, so only the return type
+        # needs declaring; hasattr-guarded like the join for stale .so files
+        if hasattr(lib, "px_wholeplan_run"):
+            lib.px_wholeplan_run.restype = ctypes.c_int64
         if hasattr(lib, "px_join_run"):
             lib.px_join_run.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,

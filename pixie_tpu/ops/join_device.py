@@ -441,10 +441,9 @@ def device_join_gate(refresh: bool = False) -> dict:
         metrics.gauge_set("px_device_join_enabled", float(out["enabled"]),
                           help_="device-join auto-gate decision (1=device "
                                 "kernel, 0=host match)")
-        if "h2d_mbps" in out:
-            metrics.gauge_set("px_h2d_bandwidth_mbps", out["h2d_mbps"],
-                              help_="measured host->device bandwidth "
-                                    "(device-join auto-gate probe)")
+        # px_h2d_bandwidth_mbps is set by the probe itself now
+        # (transfer.h2d_bandwidth_probe memoizes per process and owns the
+        # gauge), so the gate no longer re-measures or re-exports it
         if flag == -1:
             _gate_cache = out
         return out

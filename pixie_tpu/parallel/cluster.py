@@ -390,7 +390,16 @@ class LocalCluster:
         from pixie_tpu.engine.semantics import SchemaStore, restamp_result
 
         sstore = SchemaStore(self.schemas())
+        # Whole-query transfer summary: the interactive acceptance numbers
+        # (warm resident-tier queries upload ZERO feed bytes; the native
+        # whole-plan loop engaged) readable without digging through
+        # per-agent stats — bench/interactive assertions consume this.
+        xfer = {
+            k: sum(int(s.get(k, 0)) for s in agent_stats.values())
+            for k in ("h2d_bytes", "resident_feeds", "wholeplan_native")
+        }
         for r in results.values():
             restamp_result(r, logical, sstore, reg)
             r.exec_stats["agents"] = agent_stats
+            r.exec_stats["transfer"] = xfer
         return results

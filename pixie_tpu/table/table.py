@@ -206,6 +206,17 @@ class Table:
             # it now (not at the next cursor() call, which may never come for
             # an idle-but-written table) so expiry actually frees the memory.
             self._snap_cache = None
+            # Same for device-pinned copies: the resident tier must not keep
+            # expired batches in HBM (fully-expired entries free now; a
+            # head-trim marks the entry for a lazy on-device rebase).  Cheap
+            # bookkeeping only — no device ops on the writer thread.
+            try:
+                from pixie_tpu.engine import resident
+
+                resident.on_retention_trim(
+                    self.uid, self._sealed[0].gen if self._sealed else None)
+            except Exception:  # engine layer absent/broken must not block
+                pass           # the writer (correctness does not depend on it)
 
     def _hot_bytes_locked(self) -> int:
         return sum(a.nbytes for arrs in self._hot.values() for a in arrs)

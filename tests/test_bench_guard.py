@@ -138,6 +138,76 @@ def test_rtt_floor_is_environmental_not_a_latency_point():
     assert bench.compare_bench(prior, now, threshold=0.15) == []
 
 
+def test_interactive_vs_pandas_floor():
+    """ISSUE-6 acceptance: routed interactive_1m must stay ≥5x pandas at
+    the full 1M shape — an ABSOLUTE floor, so a slow ratchet down across
+    rounds cannot hide below the relative threshold."""
+    prior, now = _doc(), _doc()
+    now["configs"]["interactive_1m"]["vs_pandas"] = 3.4
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert [r["key"] for r in regs] == ["configs.interactive_1m.vs_pandas"]
+    assert regs[0]["floor"] == 5.0 and regs[0]["now"] == 3.4
+    assert "below floor" in bench._format_regression(regs[0])
+    # at/above the floor: clean
+    now["configs"]["interactive_1m"]["vs_pandas"] = 5.0
+    assert bench.compare_bench(prior, now, threshold=0.15) == []
+    # --smoke/--quick shapes never trip the full-run floor
+    now["configs"]["interactive_1m"]["vs_pandas"] = 1.0
+    now["configs"]["interactive_1m"]["rows"] = 200_000
+    assert bench.absolute_floors(now) == []
+
+
+def test_wholeplan_unit_p50_guarded():
+    """The wholeplan_native_unit config is a guarded latency AND
+    throughput point (ISSUE-6 satellite)."""
+    prior = _doc()
+    prior["configs"]["wholeplan_native_unit"] = {
+        "rows": 1_000_000, "rows_per_sec": 60_000_000, "p50_ms": 16.0,
+        "path": "native"}
+    pts = bench.bench_latency_points(prior)
+    assert pts["configs.wholeplan_native_unit.p50_ms"] == (16.0, 1_000_000)
+    assert bench.bench_points(prior)["configs.wholeplan_native_unit"] == (
+        60_000_000, 1_000_000)
+    now = json.loads(json.dumps(prior))
+    now["configs"]["wholeplan_native_unit"]["p50_ms"] = 25.0  # +56%
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert "configs.wholeplan_native_unit.p50_ms" in [r["key"] for r in regs]
+    # a silent native->interpreted dispatch fallback fails even when the
+    # p50 holds
+    now2 = json.loads(json.dumps(prior))
+    now2["configs"]["wholeplan_native_unit"]["path"] = "interpreted"
+    regs2 = bench.compare_bench(prior, now2, threshold=0.15)
+    assert [r["key"] for r in regs2] == [
+        "configs.wholeplan_native_unit.path"]
+    assert "native -> interpreted" in bench._format_regression(regs2[0])
+    # shape-mismatched (smoke) runs don't compare the path either
+    now2["configs"]["wholeplan_native_unit"]["rows"] = 200_000
+    assert bench.compare_bench(prior, now2, threshold=0.15) == []
+
+
+def test_budget_json_line_sheds_diagnostics_keeps_headline():
+    """The stdout line must fit the driver's ~2000-char tail cap
+    (BENCH_r05's line outgrew it and the round parsed as null): the
+    budgeter sheds diagnostic keys in priority order, never headline
+    ones."""
+    doc = _doc()
+    doc["metric"] = "x"
+    doc["value"] = 1
+    doc["exec_split"] = {f"c{i}": {"e2e_ms": 1.0,
+                                   "_debug": {"pad": "y" * 120}}
+                        for i in range(8)}
+    doc["roofline"] = {"note": "z" * 400}
+    doc["sketch_update"] = {"note": "w" * 400}
+    line = bench.budget_json_line(doc, cap=1200)
+    assert len(line) <= 1200
+    out = json.loads(line)
+    assert out["metric"] == "x" and "configs" in out and "sweep" in out
+    assert "_debug" not in json.dumps(out.get("exec_split", {}))
+    # under budget: nothing shed
+    small = {"metric": "x", "configs": {}, "roofline": {"n": 1}}
+    assert json.loads(bench.budget_json_line(small, cap=1200)) == small
+
+
 def test_check_regressions_cli_paths(tmp_path, capsys):
     """File mode: a doc with a dropped config fails (exit 1) against the
     repo's prior BENCH round; the prior round's own numbers pass (exit 0)."""

@@ -82,7 +82,10 @@ def _all_span_rows(stores: dict) -> list[dict]:
 
 @pytest.fixture
 def cluster():
-    broker = Broker(hb_expiry_s=1.0, query_timeout_s=30.0).start()
+    # hb_expiry is a liveness FALLBACK here, not under test (agent death is
+    # signaled by socket close); a 1 s window false-expired live agents on
+    # loaded CI boxes (>1 s scheduler stalls observed), flaking the suite
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=30.0).start()
     stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
     agents = [
         Agent(name, "127.0.0.1", broker.port, store=st, heartbeat_s=0.2).start()
@@ -291,7 +294,7 @@ class _MiscountingAgent(Agent):
 
 
 def test_agent_dying_mid_stream_fails_query_cleanly():
-    broker = Broker(hb_expiry_s=1.0, query_timeout_s=10.0).start()
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=10.0).start()
     stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
     a1 = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
                heartbeat_s=0.2).start()
@@ -320,7 +323,7 @@ def test_agent_dying_mid_stream_fails_query_cleanly():
 
 
 def test_chunk_count_mismatch_fails_query():
-    broker = Broker(hb_expiry_s=1.0, query_timeout_s=10.0).start()
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=10.0).start()
     stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
     a1 = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
                heartbeat_s=0.2).start()

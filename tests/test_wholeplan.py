@@ -1,0 +1,342 @@
+"""Whole-plan native codegen (native/wholeplan.cc via native/codegen.py).
+
+Parity contract: for every fused op shape the native loop supports, its
+results equal the interpreted jitted-kernel path's (`PX_WHOLEPLAN_NATIVE=0`)
+— exact for integer aggregates and group keys, standard frame tolerance for
+float reductions (accumulation grouping differs across paths by design;
+see wholeplan.cc's numeric contract).  Shapes outside the lowering's scope
+must fall back to the interpreted path, never mis-lower.
+"""
+import numpy as np
+import pandas as pd
+import pytest
+
+import pixie_tpu  # noqa: F401  (x64)
+from pixie_tpu import flags
+from pixie_tpu.engine.executor import PlanExecutor
+from pixie_tpu.engine.plancache import native_programs
+from pixie_tpu.native import codegen
+from pixie_tpu.plan import (
+    AggExpr, AggOp, Call, Column, FilterOp, LimitOp, MapOp, MemorySinkOp,
+    MemorySourceOp, Plan, lit,
+)
+from pixie_tpu.table import TableStore
+from pixie_tpu.types import DataType as DT, Relation
+
+SEC = 1_000_000_000
+
+pytestmark = pytest.mark.skipif(
+    codegen._native() is None, reason="native toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    native_programs.clear()
+    yield
+    native_programs.clear()
+
+
+def _store(n=120_000, seed=3):
+    rng = np.random.default_rng(seed)
+    ts = TableStore()
+    t = ts.create(
+        "events",
+        Relation.of(("time_", DT.TIME64NS), ("service", DT.STRING),
+                    ("latency", DT.FLOAT64), ("status", DT.INT64),
+                    ("ok", DT.BOOLEAN)),
+        batch_rows=1 << 14,
+    )
+    t.write({
+        "time_": np.sort(rng.integers(0, 600 * SEC, n)).astype(np.int64),
+        "service": rng.choice([f"svc-{i}" for i in range(12)], n).tolist(),
+        "latency": rng.exponential(50.0, n),
+        "status": rng.choice([200, 404, 500], n).astype(np.int64),
+        "ok": rng.random(n) < 0.8,
+    })
+    return ts
+
+
+def _plan(groups, values, chain_ops=(), src_kw=None):
+    p = Plan()
+    node = p.add(MemorySourceOp(table="events", **(src_kw or {})))
+    for op in chain_ops:
+        node = p.add(op, parents=[node])
+    agg = p.add(AggOp(groups=groups, values=values), parents=[node])
+    p.add(MemorySinkOp(name="out"), parents=[agg])
+    return p
+
+
+def _run_both(ts, plan, expect_native=True):
+    """(native out, interpreted out, native stats)."""
+    ex = PlanExecutor(plan, ts, mesh=None)
+    out = ex.run()["out"]
+    took_native = bool(ex.stats.get("wholeplan_native"))
+    assert took_native == expect_native, ex.stats
+    flags.set_for_testing("PX_WHOLEPLAN_NATIVE", False)
+    try:
+        native_programs.clear()
+        out2 = PlanExecutor(plan, ts, mesh=None).run()["out"]
+    finally:
+        flags.set_for_testing("PX_WHOLEPLAN_NATIVE", True)
+    return out, out2, ex.stats
+
+
+def _cmp(a, b, sort_cols):
+    ga = a.to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    gb = b.to_pandas().sort_values(sort_cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(ga, gb, check_dtype=False)
+    # integer columns must be EXACT (wrap-mod-2^64 sums, counts, extrema)
+    for c in ga.columns:
+        if ga[c].dtype.kind in "iu":
+            np.testing.assert_array_equal(ga[c].to_numpy(), gb[c].to_numpy())
+
+
+ALL_VALUES = [
+    AggExpr("cnt", "count", None), AggExpr("avg", "mean", "latency"),
+    AggExpr("s", "sum", "latency"), AggExpr("si", "sum", "status"),
+    AggExpr("mn", "min", "latency"), AggExpr("mx", "max", "latency"),
+    AggExpr("mni", "min", "status"), AggExpr("mxi", "max", "status"),
+    AggExpr("p50", "p50", "latency"), AggExpr("p99", "p99", "latency"),
+    AggExpr("v", "variance", "latency"), AggExpr("sd", "stddev", "latency"),
+    AggExpr("qs", "quantiles", "latency"),
+]
+
+
+def test_all_udas_filtered_dict_and_int_keys():
+    """The full UDA set over the config-1 shape: filter + dict key +
+    intdevice key, every supported aggregate in one plan."""
+    ts = _store()
+    plan = _plan(["service", "status"], ALL_VALUES,
+                 [FilterOp(expr=Call("not_equal",
+                                     (Column("status"), lit(404))))])
+    a, b, stats = _run_both(ts, plan)
+    assert stats.get("np_fast_polls") is None  # codegen owns this shape
+    _cmp(a, b, ["service", "status"])
+
+
+@pytest.mark.parametrize("fn,rhs", [
+    ("equal", 200), ("not_equal", 404), ("less", 450),
+    ("less_equal", 404), ("greater", 200), ("greater_equal", 404),
+])
+def test_every_comparison_op(fn, rhs):
+    ts = _store(n=60_000)
+    plan = _plan(["service"],
+                 [AggExpr("cnt", "count", None),
+                  AggExpr("avg", "mean", "latency")],
+                 [FilterOp(expr=Call(fn, (Column("status"), lit(rhs))))])
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["service"])
+
+
+def test_float_predicate_and_literal_on_left():
+    ts = _store(n=60_000)
+    plan = _plan(["service"], [AggExpr("cnt", "count", None)],
+                 [FilterOp(expr=Call("less", (Column("latency"),
+                                              lit(30.0)))),
+                  FilterOp(expr=Call("greater", (lit(5.0),
+                                                 Column("latency"))))])
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["service"])
+
+
+def test_bare_boolean_column_predicate():
+    ts = _store(n=60_000)
+    plan = _plan(["service"], [AggExpr("cnt", "count", None)],
+                 [FilterOp(expr=Column("ok"))])
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["service"])
+
+
+def test_window_key_with_filter():
+    """The windowed dashboard shape with a predicate: np_partial refuses
+    chains with filter steps, so the native loop owns it — raw-time binning
+    must equal the kernel's post-map bin codes."""
+    ts = _store()
+    w = 10 * SEC
+    plan = _plan(
+        ["time_", "service"],
+        [AggExpr("cnt", "count", None), AggExpr("p50", "p50", "latency"),
+         AggExpr("avg", "mean", "latency")],
+        [FilterOp(expr=Call("not_equal", (Column("status"), lit(404)))),
+         MapOp(exprs=[
+             ("time_", Call("bin", (Column("time_"), lit(w)))),
+             ("service", Column("service")),
+             ("latency", Column("latency")),
+         ])],
+    )
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["time_", "service"])
+
+
+def test_rename_map_passthrough():
+    ts = _store(n=60_000)
+    plan = _plan(
+        ["svc"],
+        [AggExpr("cnt", "count", None), AggExpr("avg", "mean", "lat")],
+        [MapOp(exprs=[("svc", Column("service")),
+                      ("lat", Column("latency")),
+                      ("code", Column("status"))]),
+         FilterOp(expr=Call("not_equal", (Column("code"), lit(404))))],
+    )
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["svc"])
+
+
+def test_bounded_time_parity():
+    """Row-level time bounds apply inside the native loop (pass-through
+    time column; the source's batch pruning composes on top)."""
+    ts = _store()
+    plan = _plan(["service"],
+                 [AggExpr("cnt", "count", None),
+                  AggExpr("p50", "p50", "latency")],
+                 [FilterOp(expr=Call("not_equal",
+                                     (Column("status"), lit(404))))],
+                 src_kw={"start_time": 100 * SEC, "stop_time": 400 * SEC})
+    a, b, _ = _run_both(ts, plan)
+    _cmp(a, b, ["service"])
+
+
+def test_bounded_time_with_window_rewrite_falls_back():
+    """Window rewrite + bounded time is the np_partial-documented
+    divergence case: the program must refuse it at run time."""
+    ts = _store()
+    w = 10 * SEC
+    plan = _plan(
+        ["time_"],
+        [AggExpr("cnt", "count", None)],
+        [FilterOp(expr=Call("not_equal", (Column("status"), lit(404)))),
+         MapOp(exprs=[("time_", Call("bin", (Column("time_"), lit(w)))),
+                      ("latency", Column("latency")),
+                      ("status", Column("status"))])],
+        src_kw={"start_time": 100 * SEC, "stop_time": 400 * SEC},
+    )
+    a, b, _ = _run_both(ts, plan, expect_native=False)
+    _cmp(a, b, ["time_"])
+
+
+def test_limit_falls_back():
+    ts = _store(n=60_000)
+    plan = _plan(["service"], [AggExpr("cnt", "count", None)],
+                 [LimitOp(n=1000)])
+    a, b, _ = _run_both(ts, plan, expect_native=False)
+    _cmp(a, b, ["service"])
+
+
+def test_computed_map_falls_back():
+    ts = _store(n=60_000)
+    plan = _plan(
+        ["service"], [AggExpr("s", "sum", "dbl")],
+        [MapOp(exprs=[("service", Column("service")),
+                      ("dbl", Call("multiply",
+                                   (Column("latency"), lit(2.0))))])],
+    )
+    a, b, _ = _run_both(ts, plan, expect_native=False)
+    _cmp(a, b, ["service"])
+
+
+def test_program_cached_per_plan_signature():
+    ts = _store(n=60_000)
+    plan = _plan(["service"], [AggExpr("cnt", "count", None)],
+                 [FilterOp(expr=Call("not_equal",
+                                     (Column("status"), lit(404))))])
+    ex1 = PlanExecutor(plan, ts, mesh=None)
+    r1 = ex1.run()["out"]
+    assert ex1.stats.get("wholeplan_native") == 1
+    before = len(native_programs._entries)
+    ex2 = PlanExecutor(plan, ts, mesh=None)
+    r2 = ex2.run()["out"]
+    assert ex2.stats.get("wholeplan_native") == 1
+    assert len(native_programs._entries) == before  # no re-lowering
+    np.testing.assert_array_equal(r1.columns["cnt"], r2.columns["cnt"])
+
+
+def test_count_only_zero_column_program(monkeypatch):
+    """group-by-none count lowers to a program with ZERO columns — the
+    native loop must not touch the (empty) column table at all.  The
+    np_partial fast path normally owns this passthrough shape, so it is
+    disabled to drive the native loop directly."""
+    from pixie_tpu.engine import np_partial
+
+    monkeypatch.setattr(np_partial, "eligible",
+                        lambda *a, **k: False)
+    ts = _store(n=60_000)
+    plan = _plan([], [AggExpr("cnt", "count", None)])
+    a, b, _ = _run_both(ts, plan)
+    assert a.columns["cnt"].tolist() == [60_000]
+    _cmp(a, b, ["cnt"])
+
+
+def test_flag_flip_respected_after_caching():
+    """PX_WHOLEPLAN_NATIVE is a LIVE kill switch: a cached program must not
+    dispatch once the flag is off, and flag-off-at-first-query must not
+    poison the cache against a later flip on."""
+    ts = _store(n=60_000)
+    plan = _plan(["service"], [AggExpr("cnt", "count", None)],
+                 [FilterOp(expr=Call("not_equal",
+                                     (Column("status"), lit(404))))])
+    ex = PlanExecutor(plan, ts, mesh=None)
+    ex.run()
+    assert ex.stats.get("wholeplan_native") == 1  # cached now
+    flags.set_for_testing("PX_WHOLEPLAN_NATIVE", False)
+    try:
+        ex2 = PlanExecutor(plan, ts, mesh=None)
+        ex2.run()
+        assert "wholeplan_native" not in ex2.stats  # cache bypassed
+    finally:
+        flags.set_for_testing("PX_WHOLEPLAN_NATIVE", True)
+    ex3 = PlanExecutor(plan, ts, mesh=None)
+    ex3.run()
+    assert ex3.stats.get("wholeplan_native") == 1  # back on, cache serves
+
+
+def test_serial_and_parallel_drivers_agree():
+    """PX_WHOLEPLAN_THREADS=1 (serial, strict row order) vs the threaded
+    range fan-out: integer state exact, float within merge rounding."""
+    ts = _store()
+    plan = _plan(["service", "status"], ALL_VALUES,
+                 [FilterOp(expr=Call("not_equal",
+                                     (Column("status"), lit(404))))])
+    a = PlanExecutor(plan, ts, mesh=None).run()["out"]
+    flags.set_for_testing("PX_WHOLEPLAN_THREADS", 1)
+    try:
+        b = PlanExecutor(plan, ts, mesh=None).run()["out"]
+    finally:
+        flags.set_for_testing("PX_WHOLEPLAN_THREADS", 0)
+    _cmp(a, b, ["service", "status"])
+
+
+def test_streaming_poll_with_filter_uses_native_loop():
+    """Delta cursors (the streaming poll shape np_partial refuses when a
+    filter is present) ride the native loop too — and the carried partial
+    states stay correct across polls."""
+    from pixie_tpu.engine.stream import stream_pxl
+
+    ts = _store(n=0 or 1)  # schema only; rows stream in below
+    rng = np.random.default_rng(9)
+    t = ts.table("events")
+    sq = stream_pxl(
+        """
+df = px.DataFrame(table='events').stream()
+df = df[df.status != 404]
+df = df.rolling('10s').agg(cnt=('latency', px.count), p50=('latency', px.p50))
+px.display(df, 'win')
+""",
+        ts,
+    )
+    emitted = 0
+    for k in range(4):
+        n = 20_000
+        t.write({
+            "time_": (np.arange(n, dtype=np.int64) + k * n) * (600 * SEC // 80_000),
+            "service": ["svc-1"] * n,
+            "latency": rng.exponential(50.0, n),
+            "status": rng.choice([200, 404, 500], n).astype(np.int64),
+            "ok": np.ones(n, dtype=bool),
+        })
+        got = sq.poll()
+        if got:
+            emitted += got["win"].num_rows
+    fin = sq.close()
+    if fin:
+        emitted += fin["win"].num_rows
+    assert emitted > 0
