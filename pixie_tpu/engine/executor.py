@@ -1097,6 +1097,15 @@ class PlanExecutor:
 
             mesh = default_mesh()
         self.mesh = mesh
+        if mesh is not None:
+            # the XLA-CPU collective-serialization workaround is a GATED
+            # decision (parallel.spmd.collective_gate), recorded per query
+            # like the device-join gate so rounds can audit it
+            from pixie_tpu.parallel.spmd import collective_gate
+
+            gate = {k: v for k, v in collective_gate(mesh).items()
+                    if k != "_key"}
+            self.stats.setdefault("device", {})["collective_gate"] = gate
 
     # ------------------------------------------------------------- routing
     def _backend_for(self, src) -> str:
@@ -1219,6 +1228,28 @@ class PlanExecutor:
         hb = self._eval_blocking(head)
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
+    def _note_shard_rows(self, per_shard) -> None:
+        """Per-shard placement accounting for SPMD feeds: accumulates each
+        feed's per-shard valid rows and keeps the skew ratio (max/mean shard
+        rows) visible — stats["shard_rows"]/["shard_skew_frac"] plus the
+        px_shard_skew_frac gauge.  1.0 = perfectly even placement; row-major
+        block sharding should stay near 1 except at uneven tails."""
+        rows = [int(x) for x in np.asarray(per_shard).reshape(-1)]
+        acc = self.stats.get("shard_rows")
+        if not isinstance(acc, list) or len(acc) != len(rows):
+            acc = [0] * len(rows)
+        acc = [a + r for a, r in zip(acc, rows)]
+        self.stats["shard_rows"] = acc
+        mean = sum(acc) / max(len(acc), 1)
+        skew = (max(acc) / mean) if mean > 0 else 1.0
+        self.stats["shard_skew_frac"] = round(skew, 4)
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.gauge_set(
+            "px_shard_skew_frac", skew,
+            help_="max/mean rows per mesh shard over this process's latest "
+                  "SPMD query feeds (placement-skew visibility; 1.0 = even)")
+
     # ------------------------------------------------------------- stream feed
     def _predicted_single_feed(self, src, cap) -> bool:
         """Exact feed count from snapshot metadata (mirrors _feed's flush
@@ -1279,7 +1310,7 @@ class PlanExecutor:
             # TPU would commit the inputs there and defeat the routing.
             cacheable = (all(g is not None for g in gens)
                          and not getattr(src, "is_delta", False))
-            if cacheable and backend == "tpu" and n_dev == 1:
+            if cacheable and backend == "tpu":
                 # Pinned-resident tier first: unlike the gen-tuple-keyed HBM
                 # cache below, a new seal FOLDS into the resident buffer
                 # (only the delta rows cross the link) instead of
@@ -1288,10 +1319,21 @@ class PlanExecutor:
                 # cache entry for this exact feed (e.g. from a transient
                 # budget fallback) is handed over for ADOPTION and then
                 # dropped, so the bytes are never uploaded or pinned twice.
+                # SPMD consumers (n_dev > 1) get the SHARDED-resident tier:
+                # the same entry pinned column-wise across the mesh with a
+                # NamedSharding, so warm sharded queries reshard nothing
+                # and ingest deltas fold shard-local.
+                sharding = None
+                if n_dev > 1:
+                    from jax.sharding import NamedSharding, PartitionSpec as P
+                    from pixie_tpu.parallel.spmd import AGENT_AXIS
+
+                    sharding = NamedSharding(self.mesh, P(AGENT_AXIS))
                 lkey = (table_id, tuple(gens), tuple(names), n_dev, backend)
                 got = resident.feed(table_id, tuple(names), gens, cap,
                                     parts, n,
-                                    prewarmed=_device_cache_get(lkey))
+                                    prewarmed=_device_cache_get(lkey),
+                                    sharding=sharding, n_dev=n_dev)
                 if got is not None:
                     _device_cache_pop(lkey)
                     rcols, h2d = got
@@ -2316,6 +2358,7 @@ class PlanExecutor:
                     nv = per_shard_valid(n_valid, bucket, n_dev)
                     partials.append(spmd_step(cols, nv, t_lo, t_hi, luts))
                     self.stats["spmd_feeds"] = self.stats.get("spmd_feeds", 0) + 1
+                    self._note_shard_rows(nv)
                 else:
                     dispatch_plain(cols, n_valid)
                 if self.analyze:

@@ -73,9 +73,10 @@ stats = {"hits": 0, "folds": 0, "rebases": 0, "admissions": 0,
 
 class _Entry:
     __slots__ = ("gen_lo", "gen_hi", "rows", "batch_rows", "bucket", "cols",
-                 "nbytes", "epoch", "trim_to")
+                 "nbytes", "epoch", "trim_to", "sharding")
 
-    def __init__(self, gen_lo, gen_hi, rows, batch_rows, bucket, cols):
+    def __init__(self, gen_lo, gen_hi, rows, batch_rows, bucket, cols,
+                 sharding=None):
         self.gen_lo = gen_lo
         self.gen_hi = gen_hi
         self.rows = rows
@@ -85,6 +86,10 @@ class _Entry:
         self.nbytes = sum(v.nbytes for v in cols.values())
         self.epoch = 0
         self.trim_to: Optional[int] = None
+        #: None = single-device entry; a jax NamedSharding = SHARDED-resident
+        #: entry, each column pinned row-block-wise across a device mesh (the
+        #: GSPMD column layout — SPMD queries consume it with zero reshard)
+        self.sharding = sharding
 
 
 def _next_pow2(n: int) -> int:
@@ -96,33 +101,56 @@ def _next_pow2(n: int) -> int:
 # actually uses the tier).
 
 _KERNELS = None
+#: sharded-entry kernel variants, one set per (mesh, spec): identical math,
+#: but jitted with out_shardings so fold/grow/shift products KEEP the
+#: NamedSharding instead of decaying to single-device (a decayed buffer
+#: would silently reshard every later SPMD consumer)
+_SHARD_KERNELS: dict = {}
 
 
-def _kernels():
+def _kernels(sharding=None):
     global _KERNELS
-    if _KERNELS is None:
-        import jax
-        import jax.numpy as jnp
+    import jax
+    import jax.numpy as jnp
 
-        @jax.jit
+    def build(**jit_kw):
+        @partial(jax.jit, **jit_kw)
         def fold(buf, delta, off):
             # epoch-keyed append: off is a TRACED scalar, so every fold of
             # the same (buffer, delta) shape reuses one compiled kernel
             return jax.lax.dynamic_update_slice(buf, delta, (off,))
 
-        @partial(jax.jit, static_argnames=("extra",))
+        @partial(jax.jit, static_argnames=("extra",), **jit_kw)
         def grow(buf, extra):
             return jnp.pad(buf, (0, extra))
 
-        @jax.jit
+        @partial(jax.jit, **jit_kw)
         def shift(buf, drop):
             # head rebase after a retention trim: retained rows move to the
             # front; the wrapped tail is garbage but sits past n_valid and
             # every consumer masks by n_valid
             return jnp.roll(buf, -drop)
 
-        _KERNELS = (fold, grow, shift)
-    return _KERNELS
+        return fold, grow, shift
+
+    if sharding is None:
+        if _KERNELS is None:
+            _KERNELS = build()
+        return _KERNELS
+    key = (id(sharding.mesh), tuple(sharding.spec))
+    got = _SHARD_KERNELS.get(key)
+    if got is None:
+        # sharded fold/grow/shift are MULTI-DEVICE programs: on an XLA-CPU
+        # mesh they must take the same collective-serialization lock as
+        # every other mesh execution — an unserialized fold racing a locked
+        # SPMD agg splits the shared intra-op pool between their
+        # rendezvous and deadlocks (parallel.spmd.collective_gate)
+        from pixie_tpu.parallel.spmd import serialize_cpu_collectives
+
+        got = _SHARD_KERNELS[key] = tuple(
+            serialize_cpu_collectives(k, sharding.mesh)
+            for k in build(out_shardings=sharding))
+    return got
 
 
 def _budget_bytes() -> int:
@@ -145,9 +173,11 @@ def _evict_lru_locked(need: int, keep_key) -> bool:
     return True
 
 
-def _device_put(host_cols: dict) -> dict:
+def _device_put(host_cols: dict, sharding=None) -> dict:
     import jax
 
+    if sharding is not None:
+        return {k: jax.device_put(v, sharding) for k, v in host_cols.items()}
     return {k: jax.device_put(v) for k, v in host_cols.items()}
 
 
@@ -169,7 +199,8 @@ def assemble_padded(parts: list, names, bucket: int) -> dict:
 
 
 def feed(table_uid: int, names: tuple, gens: list, batch_rows: int,
-         parts: list, n_rows: int, prewarmed=None):
+         parts: list, n_rows: int, prewarmed=None, sharding=None,
+         n_dev: int = 1):
     """Serve one sealed-only feed from the resident tier.
 
     → (device cols dict padded to the entry bucket, h2d_bytes) or None
@@ -180,32 +211,50 @@ def feed(table_uid: int, names: tuple, gens: list, batch_rows: int,
     `prewarmed` optionally carries the legacy gen-tuple HBM-cache entry
     for exactly this feed: admission then ADOPTS those device arrays
     instead of re-uploading the same bytes alongside them.
+
+    `sharding`/`n_dev` select the SHARDED-resident tier: entries keyed per
+    mesh width, columns pinned with the NamedSharding (GSPMD row-block
+    layout over the mesh axis), ingest deltas folding shard-local via the
+    out_shardings fold kernels — so warm SPMD queries consume the handle
+    with zero H2D bytes AND zero resharding.  Single-device (n_dev=1) and
+    sharded entries coexist; they never alias (the key carries n_dev).
     """
     if not _flags.get("PL_HBM_RESIDENT") or not gens:
+        return None
+    if not all(isinstance(g, (int, np.integer)) for g in gens):
+        # tabletized tables namespace gens as (tablet id, gen) tuples —
+        # no linear fold frontier exists across a chained cursor; stream
         return None
     if any(gens[i + 1] != gens[i] + 1 for i in range(len(gens) - 1)):
         return None  # time-pruned cursor skipped interior batches
     if any(len(p[names[0]]) != batch_rows for p in parts):
         return None
+    if n_dev > 1:
+        if sharding is None:
+            return None
+        bucket = max(_next_pow2(n_rows), MIN_BUCKET)
+        if bucket % n_dev:
+            return None  # not row-block shardable; caller streams
     # one feed mutates a given entry at a time: concurrent warm queries
     # over the same table would otherwise both compute the same delta and
     # double-fold it (other tables' feeds proceed in parallel)
-    with _entry_lock((table_uid, names)):
+    with _entry_lock((table_uid, names, n_dev)):
         return _feed_locked(table_uid, names, gens, parts, batch_rows,
-                            n_rows, prewarmed)
+                            n_rows, prewarmed, sharding, n_dev)
 
 
 def _feed_locked(table_uid, names, gens, parts, batch_rows, n_rows,
-                 prewarmed=None):
+                 prewarmed=None, sharding=None, n_dev: int = 1):
     global _TIER_BYTES
     g0, g1 = int(gens[0]), int(gens[-1])
-    key = (table_uid, names)
+    key = (table_uid, names, n_dev)
     with _LOCK:
         entry = _TIER.get(key)
         if entry is not None:
             _TIER.move_to_end(key)
     if entry is None:
-        return _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed)
+        return _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed,
+                      sharding)
     # lazily apply a pending retention trim before range math
     if entry.trim_to is not None and entry.trim_to > entry.gen_lo:
         _rebase(entry, entry.trim_to)
@@ -230,7 +279,8 @@ def _feed_locked(table_uid, names, gens, parts, batch_rows, n_rows,
         with _LOCK:
             _TIER.pop(key, None)
             _TIER_BYTES -= entry.nbytes
-        return _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed)
+        return _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed,
+                      sharding)
     # overlap/extension: fold only the genuinely new batches.  A cursor
     # starting PAST the entry head without a pending trim is a
     # time-pruned head (the head batches are still retained and other
@@ -252,12 +302,24 @@ def _feed_locked(table_uid, names, gens, parts, batch_rows, n_rows,
     return dict(entry.cols), h2d
 
 
-def _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed=None):
+def _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed=None,
+           sharding=None):
     global _TIER_BYTES
     names = key[1]
     bucket = max(_next_pow2(n_rows), MIN_BUCKET)
+
+    def adoptable(arr):
+        if arr.shape != (bucket,):
+            return False
+        # a sharded entry may only adopt arrays already placed with the SAME
+        # sharding — adopting a single-device array would silently reshard
+        # (and mis-account) every later consumer
+        if sharding is not None:
+            return getattr(arr, "sharding", None) == sharding
+        return True
+
     if (prewarmed is not None
-            and all(n in prewarmed and prewarmed[n].shape == (bucket,)
+            and all(n in prewarmed and adoptable(prewarmed[n])
                     for n in names)):
         # adopt the legacy gen-tuple cache's device arrays for this exact
         # feed: zero re-upload, and the caller evicts the legacy entry so
@@ -281,8 +343,8 @@ def _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed=None):
                       "through the legacy path")
             return None
     if cols is None:
-        cols = _device_put(host)
-    entry = _Entry(g0, g1, n_rows, batch_rows, bucket, cols)
+        cols = _device_put(host, sharding)
+    entry = _Entry(g0, g1, n_rows, batch_rows, bucket, cols, sharding)
     with _LOCK:
         old = _TIER.pop(key, None)
         if old is not None:
@@ -297,7 +359,7 @@ def _admit(key, g0, g1, batch_rows, parts, n_rows, prewarmed=None):
 
 def _rebase(entry: _Entry, new_lo: int) -> None:
     """Drop expired head batches on device (one jitted roll per column)."""
-    _fold_k, _grow_k, shift_k = _kernels()
+    _fold_k, _grow_k, shift_k = _kernels(entry.sharding)
     drop = (new_lo - entry.gen_lo) * entry.batch_rows
     entry.cols = {k: shift_k(v, np.int64(drop)) for k, v in entry.cols.items()}
     entry.rows -= drop
@@ -316,7 +378,7 @@ def _fold(key, entry: _Entry, delta_parts: list, new_hi: int):
     """Append new sealed batches in place; → uploaded delta bytes or None
     (growth blew the budget — entry dropped, caller streams)."""
     global _TIER_BYTES
-    fold_k, grow_k, _shift_k = _kernels()
+    fold_k, grow_k, _shift_k = _kernels(entry.sharding)
     names = key[1]
     add_rows = sum(len(p[names[0]]) for p in delta_parts)
     new_rows = entry.rows + add_rows

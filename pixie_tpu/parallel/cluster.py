@@ -396,8 +396,16 @@ class LocalCluster:
         # per-agent stats — bench/interactive assertions consume this.
         xfer = {
             k: sum(int(s.get(k, 0)) for s in agent_stats.values())
-            for k in ("h2d_bytes", "resident_feeds", "wholeplan_native")
+            for k in ("h2d_bytes", "resident_feeds", "wholeplan_native",
+                      "spmd_feeds", "mesh_shuffles")
         }
+        # placement skew across mesh shards: worst agent's max/mean shard
+        # rows (satellite of the sharded-table-store round — feed bytes sum
+        # across shards above; skew makes uneven placement visible)
+        skews = [s.get("shard_skew_frac") for s in agent_stats.values()
+                 if isinstance(s.get("shard_skew_frac"), (int, float))]
+        if skews:
+            xfer["shard_skew_frac"] = max(skews)
         for r in results.values():
             restamp_result(r, logical, sstore, reg)
             r.exec_stats["agents"] = agent_stats

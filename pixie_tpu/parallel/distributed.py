@@ -51,6 +51,21 @@ _STREAMABLE = (MapOp, FilterOp, LimitOp)
 _INF = float("inf")
 
 
+def _mesh_parts(agents) -> int:
+    """Pod-scale shuffle width from the producers' EXPLICIT device meshes:
+    the largest pow2-clamped AgentInfo.n_devices (≥2) among them, else 1.
+    None ("auto") stays 1 — the planner must not guess a mesh it cannot
+    see, and agent-count partitioning is always correct; an agent whose
+    mesh is narrower than the chosen width simply host-exchanges its side
+    (partition_ids() assignment is identical either way)."""
+    best = 1
+    for a in agents:
+        n = getattr(a, "n_devices", None)
+        if isinstance(n, int) and n >= 2:
+            best = max(best, 1 << (n.bit_length() - 1))
+    return best
+
+
 @dataclasses.dataclass
 class Channel:
     """One remote edge (reference: a GRPCSink/GRPCSourceGroup pair keyed by
@@ -246,8 +261,18 @@ class DistributedPlanner:
             """Large-large equijoin: hash-exchange both UNAGGREGATED sides
             into key-disjoint partitions instead of funneling full rows to
             one merger join (reference splitter shuffle, splitter.h:114-155).
-            Returns False when the shape doesn't qualify (single producer,
-            keyless/cross join, limited side ⇒ small side)."""
+            Returns False when the shape doesn't qualify (keyless/cross
+            join, limited side ⇒ small side, or a single producer with no
+            multi-device mesh).
+
+            Pod-scale width: the partition count is decoupled from the
+            agent count — when producers declare EXPLICIT device meshes
+            (AgentInfo.n_devices), the shuffle widens to the largest mesh so
+            each mesh device owns one partition and the PartitionSink
+            exchange lowers to ONE lax.all_to_all over the mesh (the
+            executor's in-mesh path).  A single agent with an 8-device mesh
+            therefore still gets an 8-way shuffled join — partitions are
+            device shards, not host processes."""
             from pixie_tpu.plan.plan import JoinOp, PartitionSinkOp
 
             if not (isinstance(op, JoinOp) and len(parents) == 2
@@ -257,8 +282,10 @@ class DistributedPlanner:
                 return False
             prods_l = producers_for(parents[0])
             prods_r = producers_for(parents[1])
-            n_parts = len({a.name for a in prods_l}
-                          | {a.name for a in prods_r})
+            n_parts = max(
+                len({a.name for a in prods_l} | {a.name for a in prods_r}),
+                _mesh_parts(prods_l + prods_r),
+            )
             if n_parts < 2:
                 return False
             j = next(chan_ids)

@@ -201,6 +201,43 @@ def _device_key_fn(hb, keys):
     return key_fn
 
 
+#: compiled exchange kernels, keyed by (mesh, keys, dict contents, dtypes,
+#: shard rows, bucket cap) — without this every shuffle RE-JITTED its
+#: all_to_all program (closure identity defeats jax's jit cache), which at
+#: real sizes costs more than the exchange itself.  Dictionaries are
+#: append-only, so (id, size) pins content exactly (same convention as the
+#: executor's kernel cache).
+import collections as _collections
+
+_EXCHANGE_CACHE: "_collections.OrderedDict[tuple, tuple]" = \
+    _collections.OrderedDict()
+_EXCHANGE_CACHE_MAX = 32
+_EXCHANGE_LOCK = __import__("threading").Lock()
+
+
+def _exchange_cached(key, build):
+    with _EXCHANGE_LOCK:
+        got = _EXCHANGE_CACHE.get(key)
+        if got is not None:
+            _EXCHANGE_CACHE.move_to_end(key)
+            return got
+    got = build()
+    with _EXCHANGE_LOCK:
+        _EXCHANGE_CACHE[key] = got
+        while len(_EXCHANGE_CACHE) > _EXCHANGE_CACHE_MAX:
+            _EXCHANGE_CACHE.popitem(last=False)
+    return got
+
+
+def _exchange_sig(hb, keys, mesh, per: int, extra=()):
+    return (id(mesh), tuple(keys),
+            tuple((k, id(d), d.size) for k, d in sorted(hb.dicts.items())
+                  if k in keys),
+            tuple((k, str(np.asarray(v).dtype))
+                  for k, v in sorted(hb.cols.items())),
+            per, *extra)
+
+
 def mesh_partition_exchange(hb, keys, n_parts: int, mesh):
     """Keyed repartition of a HostBatch over an agent's device mesh: rows
     shard across devices, ONE lax.all_to_all delivers partition p's rows to
@@ -211,10 +248,17 @@ def mesh_partition_exchange(hb, keys, n_parts: int, mesh):
     Requires n_parts == mesh size (device d IS partition d).  Partition
     assignment matches partition_ids() exactly, so mesh-exchanged and
     host-exchanged producers interoperate within one join stage.
-    """
-    import jax
-    import jax.numpy as jnp
 
+    Real-size shape: the exchange is TWO passes.  A counts pass buckets
+    every row and reads back one tiny [n_dev, n_dev] count matrix; the
+    host sizes the per-bucket capacity to the MEASURED max (pow2-rounded
+    for compile reuse) and the exchange pass ships [n_dev, cap] blocks.
+    The old single-pass kernel padded every bucket to the full shard size —
+    an n_dev× memory blow-up (a 64M-row side over 8 devices materialized
+    512M row slots); with a hash-balanced key the measured cap keeps the
+    exchange O(rows · skew) instead of O(rows · n_dev).
+    """
+    from pixie_tpu.engine import transfer
     from pixie_tpu.engine.executor import HostBatch
 
     axis = mesh.axis_names[0]
@@ -226,8 +270,6 @@ def mesh_partition_exchange(hb, keys, n_parts: int, mesh):
     rows = hb.num_rows
     per = max(1, -(-rows // n_dev))  # ceil; >=1 so shards are non-empty
     padded = per * n_dev
-    part_hash = _device_key_fn(hb, keys)
-    fn = mesh_repartition(mesh, axis, part_hash, dict(hb.dtypes))
 
     cols_dev = {}
     for name, col in hb.cols.items():
@@ -237,33 +279,134 @@ def mesh_partition_exchange(hb, keys, n_parts: int, mesh):
         cols_dev[name] = a
     n_valid = np.minimum(
         np.maximum(rows - per * np.arange(n_dev), 0), per).astype(np.int64)
-    exchanged, counts = fn(cols_dev, n_valid)
-    from pixie_tpu.engine import transfer
 
+    # ---- pass 1: bucket counts (and the per-row partition ids, kept on
+    # device for reuse — hashing runs once, not twice).  _device_key_fn
+    # builds inside the cache-miss lambdas only: it CRC32s every dictionary
+    # value, and a warm shuffle never needs it
+    counts_fn = _exchange_cached(
+        _exchange_sig(hb, keys, mesh, per, ("counts",)),
+        lambda: mesh_bucket_counts(mesh, axis, _device_key_fn(hb, keys),
+                                   dict(hb.dtypes)))
+    part_dev, send_counts = counts_fn(cols_dev, n_valid)
+    send_counts = np.asarray(transfer.pull(send_counts)).reshape(n_dev, n_dev)
+    max_bucket = int(send_counts.max()) if send_counts.size else 0
+    # pow2 capacity for compile reuse across steady-state shuffles; never
+    # beyond the shard size (the old kernel's bound)
+    cap = min(per, max(1 << max(0, max_bucket - 1).bit_length(), 1))
+
+    # ---- pass 2: the exchange proper at the measured capacity
+    fn = _exchange_cached(
+        _exchange_sig(hb, keys, mesh, per, ("xchg", cap)),
+        lambda: mesh_repartition(mesh, axis, _device_key_fn(hb, keys),
+                                 dict(hb.dtypes), bucket_cap=cap))
+    exchanged, counts = fn(cols_dev, n_valid, part_dev)
     exchanged, counts = transfer.pull((exchanged, counts))
     # global layout: row-block p*n_dev+i = rows device i sent to partition p;
     # counts[p*n_dev+i] = how many of those are valid
     counts = np.asarray(counts).reshape(n_dev, n_dev)
+    if int(counts.sum()) != rows:  # pragma: no cover — defensive: a capacity
+        raise Internal(              # bug must fail loudly, not drop rows
+            f"mesh exchange lost rows: sent {rows}, received "
+            f"{int(counts.sum())} (cap={cap})")
     out = []
     for p in range(n_dev):
         cols_p = {}
         for name, arr in exchanged.items():
-            blocks = np.asarray(arr).reshape(n_dev, n_dev, per)[p]
+            blocks = np.asarray(arr).reshape(n_dev, n_dev, cap)[p]
             cols_p[name] = np.concatenate(
                 [blocks[i, : counts[p, i]] for i in range(n_dev)])
         out.append(HostBatch(dict(hb.dtypes), dict(hb.dicts), cols_p))
+    # receive-side partition skew (max/mean rows per join partition) — the
+    # shuffle sibling of the executor's px_shard_skew_frac feed-placement
+    # gauge (distinct name: hash skew of join keys, not feed placement)
+    recv_rows = counts.sum(axis=1)
+    mean = recv_rows.mean() if n_dev else 0
+    skew = float(recv_rows.max() / mean) if mean > 0 else 1.0
+    from pixie_tpu import metrics as _metrics
+
+    _metrics.gauge_set(
+        "px_partition_skew_frac", skew,
+        help_="max/mean rows received per join partition in this "
+              "process's latest mesh shuffle (key-hash skew; 1.0 = even)")
     return out
 
 
-def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
+def _local_partition(key_fn, cols, n_valid, n_dev, jnp, part=None):
+    """Shared bucket math for the counts and exchange passes: per-row
+    partition (invalid rows marked n_dev), stable sort order, sorted
+    partition ids, and per-bucket counts/starts."""
+    first = next(iter(cols.values()))
+    rows = first.shape[0]
+    ridx = jnp.arange(rows)
+    valid = ridx < n_valid
+    if part is None:
+        # cast after the modulo: a uint64 hash mixed with int64 index math
+        # would silently promote everything to float64
+        part = (key_fn(cols) % n_dev).astype(jnp.int32)
+    marked = jnp.where(valid, part, n_dev)
+    # stable bucket order: sort by (partition, row index)
+    order = jnp.argsort(marked * (rows + 1) + ridx)
+    sorted_part = marked[order]
+    counts = jnp.bincount(sorted_part, length=n_dev + 1)[:n_dev].astype(
+        jnp.int64)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
+                              jnp.cumsum(counts)])[:n_dev]
+    return rows, ridx, marked, order, sorted_part, counts, starts
+
+
+def mesh_bucket_counts(mesh, axis: str, key_fn, n_cols: dict):
+    """Build the jittable COUNTS pass of the two-pass exchange.
+
+    Returns fn(cols_sharded, n_valid) -> (part, counts): `part` is each
+    row's partition id (invalid rows marked n_dev), sharded like the input
+    and reusable by the exchange pass; `counts` is the per-device bucket
+    histogram ([n_dev senders × n_dev buckets] globally) the host sizes the
+    exchange capacity from.  No collective — the only cross-device data is
+    the tiny counts readback.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n_dev = mesh.shape[axis]
+
+    def local(cols, n_valid):
+        # no sort here — counts need only the histogram; the exchange pass
+        # does the one stable sort
+        first = next(iter(cols.values()))
+        rows = first.shape[0]
+        part = (key_fn(cols) % n_dev).astype(jnp.int32)
+        marked = jnp.where(jnp.arange(rows) < n_valid[0], part, n_dev)
+        counts = jnp.bincount(marked, length=n_dev + 1)[:n_dev].astype(
+            jnp.int64)
+        return marked, counts
+
+    from pixie_tpu.parallel.spmd import serialize_cpu_collectives, shard_map
+
+    shard = shard_map(
+        local, mesh=mesh,
+        in_specs=({k: P(axis) for k in n_cols}, P(axis)),
+        out_specs=(P(axis), P(axis)),
+    )
+    return serialize_cpu_collectives(jax.jit(shard), mesh)
+
+
+def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict,
+                     bucket_cap: int | None = None):
     """Build a jittable keyed repartition over a mesh axis.
 
-    Returns fn(cols_sharded, n_valid_per_shard) -> (cols_exchanged, counts):
-    each device buckets its rows by `key_fn(cols) % n_devices`, pads buckets
-    to the shard size, and ONE lax.all_to_all delivers bucket d to device d —
-    the ICI shuffle edge (reference GRPCSink/Source exchange, but a single
-    collective).  Output rows per device are padded; `counts[d]` gives the
-    valid rows received from each peer.
+    Returns fn(cols_sharded, n_valid_per_shard, part=None) ->
+    (cols_exchanged, counts): each device buckets its rows by
+    `key_fn(cols) % n_devices` (or the precomputed `part` ids from
+    mesh_bucket_counts), lays buckets out at `bucket_cap` rows apiece
+    (default: the shard size — always safe), and ONE lax.all_to_all
+    delivers bucket d to device d — the ICI shuffle edge (reference
+    GRPCSink/Source exchange, but a single collective).  Output blocks are
+    [n_dev, bucket_cap] per device; `counts[d]` gives the valid rows
+    received from each peer.  Rows beyond a bucket's capacity scatter into
+    the dump slot — callers sizing cap from the counts pass must verify
+    conservation (mesh_partition_exchange does).
     """
     import jax
     import jax.numpy as jnp
@@ -272,51 +415,59 @@ def mesh_repartition(mesh, axis: str, key_fn, n_cols: dict):
 
     n_dev = mesh.shape[axis]
 
-    def local(cols, n_valid):
-        first = next(iter(cols.values()))
-        rows = first.shape[0]
-        # cast after the modulo: a uint64 hash mixed with int64 index math
-        # would silently promote everything to float64
-        part = (key_fn(cols) % n_dev).astype(jnp.int32)
-        ridx = jnp.arange(rows)
-        valid = ridx < n_valid
-        # stable bucket order: sort by (partition, row index)
-        order = jnp.argsort(jnp.where(valid, part, n_dev) * (rows + 1) + ridx)
-        sorted_part = jnp.where(valid, part, n_dev)[order]
-        # per-bucket counts + dense per-bucket layout [n_dev, rows]
-        counts = jnp.bincount(sorted_part, length=n_dev + 1)[:n_dev].astype(
-            jnp.int64)
-        starts = jnp.concatenate([jnp.zeros(1, jnp.int64),
-                                  jnp.cumsum(counts)])[:n_dev]
+    def local(cols, n_valid, part=None):
+        rows, ridx, _marked, order, sorted_part, counts, starts = \
+            _local_partition(key_fn, cols, n_valid[0], n_dev, jnp,
+                             part=None if part is None else part[0] if
+                             part.ndim > 1 else part)
+        cap = rows if bucket_cap is None else bucket_cap
         within = ridx - jnp.take(starts, jnp.clip(sorted_part, 0, n_dev - 1))
-        # invalid rows scatter into a dump slot past the buckets — writing
-        # them into a clipped bucket would zero real data
+        # invalid rows (and any row past a bucket's capacity) scatter into a
+        # dump slot past the buckets — writing them into a clipped bucket
+        # would zero real data
         dest = jnp.where(
-            sorted_part < n_dev,
-            jnp.clip(sorted_part, 0, n_dev - 1) * rows + within,
-            n_dev * rows,
+            (sorted_part < n_dev) & (within < cap),
+            jnp.clip(sorted_part, 0, n_dev - 1) * cap + within,
+            n_dev * cap,
         )
         buckets = {}
         for name, col in cols.items():
-            flat = jnp.zeros((n_dev * rows + 1,), col.dtype)
+            flat = jnp.zeros((n_dev * cap + 1,), col.dtype)
             src = jnp.take(col, order)
             flat = flat.at[dest].set(src)
-            buckets[name] = flat[: n_dev * rows].reshape(n_dev, rows)
+            buckets[name] = flat[: n_dev * cap].reshape(n_dev, cap)
         # ONE collective: bucket d goes to device d
         exchanged = {
             name: lax.all_to_all(b, axis, split_axis=0, concat_axis=0,
                                  tiled=False)
             for name, b in buckets.items()
         }
-        recv_counts = lax.all_to_all(counts.reshape(n_dev, 1), axis, 0, 0,
+        sent = jnp.minimum(counts, cap)
+        recv_counts = lax.all_to_all(sent.reshape(n_dev, 1), axis, 0, 0,
                                      tiled=False).reshape(n_dev)
         return exchanged, recv_counts
 
     from pixie_tpu.parallel.spmd import serialize_cpu_collectives, shard_map
 
-    shard = shard_map(
-        local, mesh=mesh,
-        in_specs=({k: P(axis) for k in n_cols}, P(axis)),
-        out_specs=({k: P(axis) for k in n_cols}, P(axis)),
-    )
-    return serialize_cpu_collectives(jax.jit(shard), mesh)
+    specs_in = ({k: P(axis) for k in n_cols}, P(axis))
+    specs_out = ({k: P(axis) for k in n_cols}, P(axis))
+
+    def local2(cols, n_valid):
+        return local(cols, n_valid)
+
+    def local3(cols, n_valid, part):
+        return local(cols, n_valid, part)
+
+    two = shard_map(local2, mesh=mesh, in_specs=specs_in,
+                    out_specs=specs_out)
+    three = shard_map(local3, mesh=mesh,
+                      in_specs=(*specs_in, P(axis)), out_specs=specs_out)
+    two_j = serialize_cpu_collectives(jax.jit(two), mesh)
+    three_j = serialize_cpu_collectives(jax.jit(three), mesh)
+
+    def run(cols, n_valid, part=None):
+        if part is None:
+            return two_j(cols, n_valid)
+        return three_j(cols, n_valid, part)
+
+    return run

@@ -16,11 +16,16 @@ replicated init and contributes only its shard's rows.
 """
 from __future__ import annotations
 
+import os as _os
+import threading as _threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pixie_tpu import flags as _flags
 
 AGENT_AXIS = "agents"
 
@@ -38,11 +43,71 @@ else:  # pragma: no cover - exercised on jax 0.4.x only
 #: AllReduceParticipantData waits).  Collective-bearing executions on a CPU
 #: mesh therefore serialize through one lock and block before releasing; on
 #: real accelerator meshes executions stay async and unlocked.
-_COLLECTIVE_EXEC_LOCK = __import__("threading").Lock()
+_COLLECTIVE_EXEC_LOCK = _threading.Lock()
+
+_SERIALIZE_FLAG = _flags.define_int(
+    "PX_SERIALIZE_CPU_COLLECTIVES", -1,
+    "serialize collective-bearing mesh executions through one process lock: "
+    "-1 = auto (on iff every mesh device is an XLA-CPU virtual device sharing "
+    "the host intra-op pool), 0 = never (trust the runtime's rendezvous), "
+    "1 = always (debugging aid)")
+
+_gate_lock = _threading.Lock()
+_gate_cache: dict | None = None
+
+
+def collective_gate(mesh: Mesh | None = None, refresh: bool = False) -> dict:
+    """The process-wide collective-serialization decision, decided once and
+    recorded like `ops.join_device.device_join_gate` — the XLA-CPU rendezvous
+    workaround is a GATED behavior with an observable reason, not an
+    unconditional code path.
+
+    → {"serialize", "reason", "flag", "platform", "mesh_devices",
+       "host_cores"}.  PX_SERIALIZE_CPU_COLLECTIVES forces it (0/1); -1 =
+    auto: serialize iff every mesh device is an XLA-CPU virtual device —
+    those share ONE host intra-op thread pool, so two concurrent
+    collective programs can split the pool between their rendezvous and
+    deadlock (`host_cores` vs `mesh_devices` records how oversubscribed the
+    pool is).  Real accelerator meshes have per-device hardware queues:
+    the gate stays OFF and executions remain async.  The executor also
+    records the decision in stats["device"]["collective_gate"].
+    """
+    global _gate_cache
+    devices = (list(mesh.devices.flat) if mesh is not None
+               else list(jax.devices()))
+    platform = devices[0].platform
+    n_mesh = mesh.size if mesh is not None else len(devices)
+    with _gate_lock:
+        flag = _flags.get("PX_SERIALIZE_CPU_COLLECTIVES")
+        key = (flag, platform, n_mesh)
+        if _gate_cache is not None and not refresh \
+                and _gate_cache.get("_key") == key:
+            return _gate_cache
+        all_cpu = all(d.platform == "cpu" for d in devices)
+        out = {"_key": key, "flag": flag, "platform": platform,
+               "mesh_devices": int(n_mesh),
+               "host_cores": _os.cpu_count() or 1}
+        if flag == 0:
+            out.update(serialize=False, reason="forced_off")
+        elif flag == 1:
+            out.update(serialize=True, reason="forced_on")
+        elif all_cpu:
+            out.update(serialize=True, reason="xla_cpu_shared_pool")
+        else:
+            out.update(serialize=False, reason="accelerator_hw_queues")
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.gauge_set(
+            "px_collective_serialize_enabled", float(out["serialize"]),
+            help_="1 when collective-bearing mesh executions serialize "
+                  "through the XLA-CPU rendezvous workaround lock "
+                  "(PX_SERIALIZE_CPU_COLLECTIVES; off on accelerators)")
+        _gate_cache = out
+        return out
 
 
 def serialize_cpu_collectives(jit_fn, mesh: Mesh):
-    if any(d.platform != "cpu" for d in mesh.devices.flat):
+    if not collective_gate(mesh)["serialize"]:
         return jit_fn
 
     def run(*args, **kwargs):

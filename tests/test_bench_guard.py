@@ -123,6 +123,33 @@ def test_mfu_and_device_join_points_guarded():
     assert bench.compare_bench(old, now, threshold=0.15) == []
 
 
+def test_sharded_agg_config_guarded():
+    """ISSUE-7: the promoted `sharded_agg_64m` config is a guarded
+    throughput AND latency point — MULTICHIP rounds carry real numbers and
+    a >15% rows/s drop or p50 rise fails the PR; smoke shapes never
+    compare against full runs."""
+    prior = _doc()
+    prior["configs"]["sharded_agg_64m"] = {
+        "rows": 64_000_000, "rows_per_sec": 40_000_000, "p50_ms": 1600.0,
+        "n_devices": 8, "mode": "local", "bit_equal": True}
+    pts = bench.bench_points(prior)
+    assert pts["configs.sharded_agg_64m"] == (40_000_000, 64_000_000)
+    lpts = bench.bench_latency_points(prior)
+    assert lpts["configs.sharded_agg_64m.p50_ms"] == (1600.0, 64_000_000)
+
+    now = json.loads(json.dumps(prior))
+    now["configs"]["sharded_agg_64m"]["rows_per_sec"] = 30_000_000  # -25%
+    regs = bench.compare_bench(prior, now, threshold=0.15)
+    assert "configs.sharded_agg_64m" in [r["key"] for r in regs]
+    now2 = json.loads(json.dumps(prior))
+    now2["configs"]["sharded_agg_64m"]["p50_ms"] = 2200.0  # +37%
+    regs2 = bench.compare_bench(prior, now2, threshold=0.15)
+    assert "configs.sharded_agg_64m.p50_ms" in [r["key"] for r in regs2]
+    # smoke shape: no comparison
+    now["configs"]["sharded_agg_64m"]["rows"] = 200_000
+    assert bench.compare_bench(prior, now, threshold=0.15) == []
+
+
 def test_rtt_floor_is_environmental_not_a_latency_point():
     """wave_rtt_floor_ms measures the ENVIRONMENT (tunnel RTT), not the
     code: a noisier box must not read as a latency regression, and the

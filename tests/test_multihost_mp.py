@@ -71,6 +71,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow  # multihost subprocess pair: outside the tier-1 budget
 def test_two_process_distributed_mesh_and_partial_agg(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
