@@ -159,7 +159,8 @@ def cmd_run(args) -> int:
         from pixie_tpu.services.client import Client
 
         host, port = args.broker.rsplit(":", 1)
-        client = Client(host, int(port), auth_token=args.auth_token)
+        client = Client(host, int(port), auth_token=args.auth_token,
+                        tenant=getattr(args, "tenant", None))
         execute = lambda fn, fargs: client.execute_script(  # noqa: E731
             source, func=fn, func_args=fargs, analyze=args.analyze
         )
@@ -287,7 +288,8 @@ def _make_runner(args):
 
         host, port = args.broker.rsplit(":", 1)
         return broker_runner(Client(host, int(port),
-                                    auth_token=args.auth_token))
+                                    auth_token=args.auth_token,
+                                    tenant=getattr(args, "tenant", None)))
     store, now = _demo_cluster()
     return local_runner(store, now=now)
 
@@ -336,6 +338,9 @@ def main(argv=None) -> int:
     run.add_argument("--broker", help="host:port (default: in-process demo data)")
     run.add_argument("--auth-token", default=None,
                      help="shared secret when the broker enables auth")
+    run.add_argument("--tenant", default=None,
+                     help="tenant id for broker admission control / quotas "
+                          "and per-tenant cache namespaces")
     run.add_argument("--arg", action="append", help="vis variable override k=v")
     run.add_argument("--analyze", action="store_true")
     run.add_argument("--max-rows", type=int, default=40)
@@ -372,12 +377,14 @@ def main(argv=None) -> int:
     ui.add_argument("--bundle", default=str(DEFAULT_SCRIPTS))
     ui.add_argument("--broker", help="host:port (default: in-process demo data)")
     ui.add_argument("--auth-token", default=None)
+    ui.add_argument("--tenant", default=None)
     ui.set_defaults(fn=cmd_ui)
 
     lv = sub.add_parser("live", help="interactive live REPL with completion")
     lv.add_argument("--bundle", default=str(DEFAULT_SCRIPTS))
     lv.add_argument("--broker", help="host:port (default: in-process demo data)")
     lv.add_argument("--auth-token", default=None)
+    lv.add_argument("--tenant", default=None)
     lv.set_defaults(fn=cmd_live)
 
     ag = sub.add_parser("agent", help="start an agent")

@@ -38,10 +38,21 @@ _flags.define_bool(
     "whole-query plan cache: warm interactive queries skip re-trace/"
     "re-analyze/re-split (bit-equal to the slow path by construction)",
 )
+_flags.define_bool(
+    "PL_TENANT_ISOLATION", True,
+    "namespace plan-cache and matview state per tenant (key prefix + "
+    "per-namespace LRU budgets) so one tenant's standing state cannot "
+    "evict another's; 0 restores the shared caches",
+)
 
-#: entries per cache instance (broker/cluster each own one); a dashboard
-#: rotates through a handful of scripts, so this is generous
+#: entries per tenant NAMESPACE per cache instance; a dashboard rotates
+#: through a handful of scripts, so this is generous.  A noisy tenant fills
+#: only its own namespace — other tenants' entries never evict for it.
 MAX_ENTRIES = 64
+
+#: hard global bound across all namespaces (memory safety against a flood
+#: of distinct tenant ids)
+MAX_TOTAL_ENTRIES = MAX_ENTRIES * 8
 
 
 def enabled() -> bool:
@@ -81,9 +92,22 @@ class QueryPlanCache:
         self.misses = 0
 
     @staticmethod
-    def key(source: str, func, func_args, default_limit, schemas_fp) -> tuple:
-        return (source, func, _freeze(func_args), default_limit,
+    def key(source: str, func, func_args, default_limit, schemas_fp,
+            tenant=None) -> tuple:
+        """Cache key; the leading slot is the tenant NAMESPACE ("" = shared).
+        With PL_TENANT_ISOLATION on, tenants never share entries (and never
+        evict each other's — see get_query's per-namespace budget)."""
+        ns = (tenant if tenant and _flags.get("PL_TENANT_ISOLATION") else "")
+        return (ns, source, func, _freeze(func_args), default_limit,
                 _freeze(schemas_fp))
+
+    def contains(self, key: tuple) -> bool:
+        """Non-mutating peek (no LRU touch, no counters): the admission
+        gate's warm/cold cost estimate must not skew hit/miss accounting."""
+        if not enabled():
+            return False
+        with self._lock:
+            return key in self._entries
 
     def get_query(self, key: tuple, compile_fn: Callable):
         """→ (CompiledQuery, _Entry | None, hit: bool).
@@ -117,7 +141,14 @@ class QueryPlanCache:
         entry = _Entry(q)
         with self._lock:
             self._entries[key] = entry
-            while len(self._entries) > self._max:
+            # per-namespace LRU budget: evict the oldest entry of THIS
+            # key's namespace when it outgrows its own allowance, so one
+            # tenant's churn cannot evict another tenant's warm plans
+            ns = key[0]
+            ns_keys = [k for k in self._entries if k[0] == ns]
+            if len(ns_keys) > self._max:
+                self._entries.pop(ns_keys[0], None)
+            while len(self._entries) > MAX_TOTAL_ENTRIES:
                 self._entries.popitem(last=False)
         return q, entry, False
 

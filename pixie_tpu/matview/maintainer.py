@@ -24,9 +24,13 @@ rows a cold scan can't see), or a dead cursor (unread rows expired).  All
 reset the view and rebuild from the live retention frontier — the "fall
 back to full rescan" behavior, made incremental again afterwards.
 
-State budget: PL_MATVIEW_MAX_STATE_MB caps the SUM of standing-state bytes
-per manager; cold views evict LRU.  A single view larger than the whole
-budget is never retained (it would just thrash).
+State budget: PL_MATVIEW_MAX_STATE_MB caps standing-state bytes PER TENANT
+NAMESPACE (PL_TENANT_ISOLATION; the shared "" namespace when no tenant),
+so one tenant's standing state cannot evict another's; a global backstop
+of MAX_NAMESPACE_BUDGETS × budget bounds the sum across namespaces against
+tenant-id floods.  Cold views evict LRU within the over-budget scope.  A
+single view larger than the whole budget is never retained (it would just
+thrash).
 """
 from __future__ import annotations
 
@@ -58,6 +62,10 @@ flags.define_float(
     "PL_MATVIEW_REFRESH_S", 0.0,
     "background refresh cadence for registered views (the cron-tick "
     "maintainer); 0 = refresh only on query (lazily)")
+# PL_TENANT_ISOLATION (shared with the plan cache's tenant namespacing) is
+# DEFINED once in engine/plancache.py — a second define_bool here would
+# crash at import time the day the defaults diverge
+import pixie_tpu.engine.plancache  # noqa: E402,F401 — defines PL_TENANT_ISOLATION
 
 #: live managers, for the process-wide state gauges
 _MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
@@ -113,12 +121,14 @@ def _pb_nbytes(pb) -> int:
 class StandingView:
     """One registered view: prefix + delta cursor + accumulated state."""
 
-    __slots__ = ("key", "prefix", "cursor", "state", "lock", "state_bytes",
-                 "refreshes", "rows_folded", "hits", "rebuilds",
-                 "last_access", "created_at")
+    __slots__ = ("key", "ns", "prefix", "cursor", "state", "lock",
+                 "state_bytes", "refreshes", "rows_folded", "hits",
+                 "rebuilds", "stale_serves", "last_access", "created_at")
 
-    def __init__(self, key: str, prefix: ViewPrefix, table):
+    def __init__(self, key: str, prefix: ViewPrefix, table, ns: str = ""):
         self.key = key
+        self.ns = ns
+        self.stale_serves = 0
         self.prefix = prefix
         self.cursor = DeltaCursor(table)
         self.state = None  # PartialAggBatch once first refreshed
@@ -134,6 +144,8 @@ class StandingView:
     def stats(self) -> dict:
         return {
             "key": self.key,
+            "ns": self.ns,
+            "stale_serves": self.stale_serves,
             "table": self.prefix.head.table,
             "tablet": self.prefix.head.tablet,
             "groups": self.prefix.agg.groups,
@@ -181,7 +193,8 @@ class MatViewManager:
         return t if isinstance(t, Table) else None
 
     # ----------------------------------------------------------------- serve
-    def serve(self, plan: Plan, route_scale: int = 1, mesh="auto"):
+    def serve(self, plan: Plan, route_scale: int = 1, mesh="auto",
+              tenant: str = "", stale_ok: bool = False):
         """Answer an eligible agent plan from standing state.
 
         Returns (channel, PartialAggBatch, info) on a view answer, or None
@@ -191,6 +204,13 @@ class MatViewManager:
         rescan).  The returned batch is shared with the view and must be
         treated as immutable — every consumer (wire encode, combine, slice,
         finalize) already copies rather than mutates.
+
+        `tenant` namespaces the view key under PL_TENANT_ISOLATION, so one
+        tenant's standing state is invisible to (and unevictable by)
+        another's.  `stale_ok` is the serving front's degradation hint: a
+        view with standing state answers WITHOUT folding its pending delta
+        (stale-while-revalidate — the next non-degraded sight or cron tick
+        folds it), trading bounded staleness for zero scan work under load.
         """
         if not flags.get("PL_MATVIEW_ENABLED"):
             return None
@@ -200,14 +220,17 @@ class MatViewManager:
         table = self._resolve_table(pref.head)
         if table is None:
             return None
+        ns = tenant if (tenant and flags.get("PL_TENANT_ISOLATION")) else ""
         key = view_key(pref)
+        if ns:
+            key = f"{ns}:{key}"
         with self._lock:
             view = self._views.get(key)
             if view is None:
                 # first sight: register only.  Anchoring the cursor NOW means
                 # the second run folds [frontier-at-first-sight, head) — the
                 # same rows the first run scanned plus whatever arrived since.
-                self._views[key] = StandingView(key, pref, table)
+                self._views[key] = StandingView(key, pref, table, ns=ns)
                 metrics.counter_inc(
                     "px_matview_misses_total", labels={"reason": "register"},
                     help_="view lookups that could not serve standing state")
@@ -215,7 +238,7 @@ class MatViewManager:
         t0 = time.perf_counter()
         with view.lock:
             info = self._refresh_locked(view, table, route_scale=route_scale,
-                                        mesh=mesh)
+                                        mesh=mesh, stale_ok=stale_ok)
             if info is None:
                 with self._lock:
                     self._views.pop(key, None)
@@ -237,7 +260,8 @@ class MatViewManager:
 
     # --------------------------------------------------------------- refresh
     def _refresh_locked(self, view: StandingView, table,
-                        route_scale: int = 1, mesh="auto") -> Optional[dict]:
+                        route_scale: int = 1, mesh="auto",
+                        stale_ok: bool = False) -> Optional[dict]:
         """Fold the unread delta into the standing state (view.lock held).
         Returns the refresh info dict, or None after two failed attempts
         (caller falls back to a full rescan through the normal path)."""
@@ -246,6 +270,28 @@ class MatViewManager:
         rebuilt = None
         for _attempt in range(2):
             st = view.cursor.status(table)
+            if stale_ok and st == CURSOR_OK and view.state is not None:
+                # stale-while-revalidate: serve the standing state as-is; the
+                # pending delta stays unread for the next healthy refresh.
+                # Only a CURSOR_OK view may do this — an invalidated cursor
+                # means the state covers rows a cold scan couldn't see.
+                lo, hi = view.cursor.delta_bounds(table)
+                view.stale_serves += 1
+                metrics.counter_inc(
+                    "px_matview_stale_serves_total",
+                    help_="degraded-mode view answers that skipped the "
+                          "delta fold (stale-while-revalidate)")
+                return {
+                    "view": view.key,
+                    "rows_folded": 0,
+                    "stale": True,
+                    "stale_pending_rows": int(max(hi - lo, 0)),
+                    "refresh_ms": 0.0,
+                    "groups": view.state.num_groups,
+                    "state_bytes": view.state_bytes,
+                    "watermark": view.cursor.watermark,
+                    "rebuilt": rebuilt,
+                }
             if st != CURSOR_OK:
                 rebuilt = st
                 metrics.counter_inc(
@@ -349,20 +395,37 @@ class MatViewManager:
         with self._lock:
             return sum(v.state_bytes for v in self._views.values())
 
+    #: global backstop: the SUM across all tenant namespaces may not exceed
+    #: this many per-namespace budgets — tenant ids are client-supplied wire
+    #: strings, so "one full budget per namespace" alone would let an id
+    #: flood grow standing state without bound
+    MAX_NAMESPACE_BUDGETS = 4
+
     def _evict_over_budget(self, keep: Optional[str] = None) -> None:
+        """LRU eviction, accounted PER TENANT NAMESPACE: each namespace gets
+        the full PL_MATVIEW_MAX_STATE_MB budget, so a tenant flooding
+        standing state evicts only its own views — never another tenant's
+        (the shared "" namespace behaves exactly as before isolation).  A
+        GLOBAL cap of MAX_NAMESPACE_BUDGETS × budget bounds the total: past
+        it, eviction goes LRU across every namespace."""
         budget = int(flags.get("PL_MATVIEW_MAX_STATE_MB")) << 20
+        global_cap = budget * self.MAX_NAMESPACE_BUDGETS
         with self._lock:
-            total = sum(v.state_bytes for v in self._views.values())
+            totals: dict[str, int] = {}
+            for v in self._views.values():
+                totals[v.ns] = totals.get(v.ns, 0) + v.state_bytes
+            grand = sum(totals.values())
             for v in sorted(self._views.values(), key=lambda v: v.last_access):
-                if total <= budget:
-                    break
+                if totals.get(v.ns, 0) <= budget and grand <= global_cap:
+                    continue
                 # the just-served view survives LRU unless it ALONE busts the
                 # budget — retaining an oversized view would evict everything
                 # else and still be over budget on its next refresh
                 if v.key == keep and v.state_bytes <= budget:
                     continue
                 self._views.pop(v.key, None)
-                total -= v.state_bytes
+                totals[v.ns] -= v.state_bytes
+                grand -= v.state_bytes
                 metrics.counter_inc(
                     "px_matview_evictions_total",
                     help_="standing views evicted by the state byte budget")

@@ -70,6 +70,21 @@ def histogram_observe(name: str, value: float, bounds: tuple,
                 break
 
 
+def counter_value(name: str, labels: Optional[dict] = None) -> float:
+    """Current value of a counter series (0.0 when never incremented) —
+    the in-process read side tests and the serving load harness use to
+    audit admitted/shed/goodput accounting without scraping /metrics."""
+    with _lock:
+        return _counters.get(_key(name, labels), 0.0)
+
+
+def counter_series(name: str) -> dict[tuple, float]:
+    """All label-series of one counter: {labels-tuple: value}."""
+    with _lock:
+        return {labels: v for (n, labels), v in _counters.items()
+                if n == name}
+
+
 def register_gauge_fn(name: str, fn: Callable[[], dict], help_: str = "") -> None:
     """Lazy gauge: fn() -> {labels-tuple-or-frozen-dict: value} evaluated at
     render time (per-table sizes, registry liveness, ...)."""

@@ -188,18 +188,21 @@ class LocalCluster:
     def query(self, pxl_source: str, func: Optional[str] = None,
               func_args: Optional[dict] = None, now: Optional[int] = None,
               default_limit: Optional[int] = None,
-              analyze: bool = False) -> dict[str, QueryResult]:
+              analyze: bool = False,
+              tenant: Optional[str] = None) -> dict[str, QueryResult]:
         """Compile a PxL script against the cluster's combined schemas and
         execute it distributed (the ExecuteScript analog).  Warm repeats of
         the same script hit the whole-query plan cache and skip the compile
         and distributed-split work entirely (bit-equal results — the cached
-        plan IS the plan a recompile would produce)."""
+        plan IS the plan a recompile would produce).  `tenant` namespaces
+        the plan cache and standing matview state (PL_TENANT_ISOLATION) —
+        the same contract the networked broker applies per client."""
         from pixie_tpu.compiler import compile_pxl
         from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
 
         fp = self._schemas_fp()
         key = self.plan_cache.key(pxl_source, func, func_args, default_limit,
-                                  fp)
+                                  fp, tenant=tenant)
         q, entry, _hit = self.plan_cache.get_query(
             key, lambda: compile_pxl(pxl_source, self.schemas(), func=func,
                                      func_args=func_args, now=now,
@@ -209,7 +212,8 @@ class LocalCluster:
             self.apply_mutations(q.mutations)
         (dp, _extras), _shit = _QPC.get_split(
             entry, fp, lambda: (self.planner.plan(q.plan), {}))
-        return self.execute(q.plan, analyze=analyze, dp=dp)
+        return self.execute(q.plan, analyze=analyze, dp=dp,
+                            tenant=tenant or "")
 
     def apply_mutations(self, mutations: list) -> None:
         """Deploy tracepoints on every data agent and refresh the planner's
@@ -227,7 +231,7 @@ class LocalCluster:
                 a.schemas = self.stores[a.name].schemas()
 
     def execute(self, logical: Plan, analyze: bool = False,
-                dp=None) -> dict[str, QueryResult]:
+                dp=None, tenant: str = "") -> dict[str, QueryResult]:
         if dp is None:
             dp = self.planner.plan(logical)
 
@@ -248,7 +252,7 @@ class LocalCluster:
             if not analyze:
                 served = self.matviews(agent_name).serve(
                     plan, route_scale=len(items),
-                    mesh=self._agent_mesh(agent_name))
+                    mesh=self._agent_mesh(agent_name), tenant=tenant)
                 if served is not None:
                     cid, pb, info = served
                     return agent_name, {cid: pb}, {"matview": info}

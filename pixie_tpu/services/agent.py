@@ -221,7 +221,11 @@ class Agent:
         cm = (trace.root(self.tracer, "exec", ctx=tctx, agent=self.name,
                          req_id=req_id)
               if tctx else contextlib.nullcontext())
-        window = int(flags.get("PL_STREAM_WINDOW"))
+        # a degraded broker narrows the in-flight chunk window per query
+        # (serving-front backpressure: admitted queries throttle harder
+        # instead of queueing frames at a merge that can't keep up)
+        window = int(meta.get("stream_window")
+                     or flags.get("PL_STREAM_WINDOW"))
         sem = threading.Semaphore(window) if window > 0 else None
         if sem is not None:
             with self._windows_lock:
@@ -236,7 +240,9 @@ class Agent:
                 served = None
                 if not meta.get("analyze"):
                     served = self.matviews.serve(
-                        plan, route_scale=int(meta.get("route_scale", 1)))
+                        plan, route_scale=int(meta.get("route_scale", 1)),
+                        tenant=str(meta.get("tenant") or ""),
+                        stale_ok=bool(meta.get("stale_ok")))
                 if served is not None:
                     cid, pb, mv_info = served
                     ex = None

@@ -24,6 +24,7 @@ from pixie_tpu import trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.parallel.distributed import DistributedPlanner
+from pixie_tpu.serving import COST_COLD, COST_WARM, ServingFront, ShedError
 from pixie_tpu.services import wire
 from pixie_tpu.services.kvstore import KVStore
 from pixie_tpu.services.registry import AgentRegistry
@@ -33,6 +34,11 @@ from pixie_tpu.table.table import TableStore
 from pixie_tpu.types import Relation
 
 DEFAULT_QUERY_TIMEOUT_S = 60.0
+
+#: tenant id stamped on queries that arrive without one (older clients,
+#: in-process callers like cron): they share one namespace and one quota
+#: bucket rather than bypassing admission entirely
+DEFAULT_TENANT = "default"
 
 #: broker end-to-end query latency buckets (seconds)
 QUERY_LATENCY_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
@@ -177,6 +183,12 @@ class Broker:
         from pixie_tpu.engine.plancache import QueryPlanCache
 
         self.plan_cache = QueryPlanCache()
+        #: multi-tenant serving front (pixie_tpu.serving): every
+        #: ExecuteScript passes its admission gate (per-tenant token
+        #: buckets, global in-flight cap, DRR fair-share dispatch) and
+        #: returns its slot on completion.  PL_SERVING_ENABLED=0 makes it
+        #: a pass-through.
+        self.serving = ServingFront("broker")
         #: self-telemetry spans for the query path; shipped to an agent's
         #: spans table at query end (the broker holds no scanned store)
         self.tracer = trace.Tracer("broker")
@@ -236,6 +248,10 @@ class Broker:
                     "leader": lambda: (self.elector is None
                                        or self.elector.is_leader()),
                 }, host=host, port=healthz_port)
+                # READINESS only: an overloaded broker (admission queue
+                # past the shed watermark) must drop out of the serving
+                # endpoints without a liveness restart wiping its queues
+                self.healthz.add_ready_check("serving", self.serving.ready)
             self._server = Server(host, port, self._on_frame, self._on_close)
         except Exception:
             if self.healthz is not None:
@@ -257,6 +273,7 @@ class Broker:
             "agents currently live in the registry",
         )
         trace.register_gauges()
+        self.serving.attach_gauges()
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
@@ -276,6 +293,7 @@ class Broker:
         if self.elector is not None:
             self.elector.stop()
         self._server.stop()
+        self.serving.detach_gauges()
         _metrics.unregister_gauge_fn("px_broker_live_agents")
         self.kv.close()
 
@@ -525,8 +543,10 @@ class Broker:
     # ------------------------------------------------------------------- query
     def _run_query(self, client: Connection, meta: dict):
         req_id = meta.get("req_id", "")
+        tenant = str(meta.get("tenant") or DEFAULT_TENANT)
         try:
-            with trace.root(self.tracer, "query", req_id=req_id):
+            with trace.root(self.tracer, "query", req_id=req_id,
+                            tenant=tenant):
                 results, stats = self.execute_script(
                     meta["script"],
                     func=meta.get("func"),
@@ -535,6 +555,7 @@ class Broker:
                     default_limit=meta.get("default_limit"),
                     analyze=bool(meta.get("analyze", False)),
                     funcs=[tuple(f) for f in meta.get("funcs") or []] or None,
+                    tenant=tenant,
                 )
                 with trace.span("render"):
                     for name, qr in results.items():
@@ -555,12 +576,15 @@ class Broker:
                         {"msg": "done", "req_id": req_id,
                          "stats": _jsonable(stats)}
                     ))
+        except ShedError as e:
+            # admission rejection: NOT a failure of the query itself — the
+            # envelope carries the retry-after hint so clients back off
+            client.send(wire.encode_error(req_id, e,
+                                          retry_after_s=e.retry_after_s))
         except Exception as e:  # compile/plan/exec errors all surface to client
             if not isinstance(e, PxError):
                 traceback.print_exc()
-            client.send(wire.encode_json(
-                {"msg": "error", "req_id": req_id, "error": str(e)}
-            ))
+            client.send(wire.encode_error(req_id, e))
         finally:
             self._ship_spans()
 
@@ -634,9 +658,46 @@ class Broker:
                 with self._qlock:
                     self._queries.pop(rid, None)
 
+    def _admit(self, script, func, func_args, default_limit, tenant):
+        """Pass one query through the serving front's admission gate.
+
+        Cost estimate: a plan-cache peek decides warm (dispatch+merge only)
+        vs cold (full compile/split) — the same signal the DRR scheduler
+        charges, so a tenant flooding cold compiles drains proportionally
+        slower.  Raises ShedError (quota/queue-full/timeout/overload);
+        returns the Ticket to release, or None when serving is disabled.
+        """
+        trace.set_attr(tenant=tenant)
+        if not self.serving.enabled():
+            return None
+        from pixie_tpu.engine import plancache as _plancache
+
+        if not _plancache.enabled():
+            # PL_QUERY_FASTPATH=0: no warm/cold signal exists and every
+            # query pays the same full compile — price uniformly WARM so
+            # DRR stays fair by count and the overload shed (which drops
+            # cost >= COST_COLD work) cannot turn degradation into a full
+            # outage
+            cost = COST_WARM
+        else:
+            key = self.plan_cache.key(script, func, func_args, default_limit,
+                                      ("reg", self.registry.epoch),
+                                      tenant=tenant)
+            cost = COST_WARM if self.plan_cache.contains(key) else COST_COLD
+        with trace.span("admission_wait", tenant=tenant, cost=cost):
+            ticket = self.serving.admit(tenant, cost)
+        if ticket.queued:
+            # the scheduler's dispatch decision as its own span: start =
+            # enqueue, duration = queue wait (ends at dispatch)
+            trace.event_span("sched_dispatch", ticket.enqueue_ns,
+                             ticket.wait_ns, tenant=tenant, cost=cost,
+                             degraded=ticket.degraded)
+        return ticket
+
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False, funcs=None,
+        tenant: str = None,
     ) -> tuple[dict[str, QueryResult], dict]:
         """Compile + distribute + merge (the in-process core of ExecuteScript).
 
@@ -649,33 +710,55 @@ class Broker:
 
         from pixie_tpu import metrics as _metrics
 
+        tenant = str(tenant or DEFAULT_TENANT)
         _metrics.counter_inc("px_broker_queries_total",
-                             help_="ExecuteScript requests served")
+                             help_="ExecuteScript requests received")
         # In-process callers (cron, tests) get their own trace root; under
         # the networked path _run_query's root is already active and this is
         # a no-op.  Shipping happens only when this frame owns the root.
         owns_root = trace.enabled() and trace.current() is None
         t0 = _time.perf_counter()
+        shed = False
         try:
             with trace.maybe_root(self.tracer, "query"):
-                return self._execute_script_inner(
-                    script, func, func_args, now, default_limit, analyze, funcs
-                )
+                ticket = self._admit(script, func, func_args, default_limit,
+                                     tenant)
+                ok = False
+                try:
+                    results, stats = self._execute_script_inner(
+                        script, func, func_args, now, default_limit, analyze,
+                        funcs, tenant=tenant, ticket=ticket,
+                    )
+                    ok = True
+                    return results, stats
+                finally:
+                    self.serving.release(ticket, ok=ok)
+        except ShedError:
+            # admission rejections are flow control, not query failures —
+            # they are counted under px_serving_shed_total instead
+            shed = True
+            raise
         except Exception:
             _metrics.counter_inc("px_broker_query_errors_total",
                                  help_="ExecuteScript requests that failed")
             raise
         finally:
-            _metrics.histogram_observe(
-                "px_broker_query_latency_seconds",
-                _time.perf_counter() - t0, QUERY_LATENCY_BOUNDS,
-                help_="broker end-to-end ExecuteScript latency")
+            if not shed:
+                # sheds stay out of the latency SLO histogram: a flood of
+                # sub-ms rejections (or 30s queue-timeout sheds) during
+                # overload would swamp the distribution of queries that
+                # actually EXECUTED — exactly when the SLO signal matters
+                _metrics.histogram_observe(
+                    "px_broker_query_latency_seconds",
+                    _time.perf_counter() - t0, QUERY_LATENCY_BOUNDS,
+                    help_="broker end-to-end ExecuteScript latency "
+                          "(executed queries; sheds excluded)")
             if owns_root:
                 self._ship_spans()
 
     def _execute_script_inner(
         self, script, func, func_args, now, default_limit, analyze,
-        funcs=None,
+        funcs=None, tenant: str = DEFAULT_TENANT, ticket=None,
     ) -> tuple[dict[str, QueryResult], dict]:
         import time as _time
 
@@ -720,7 +803,7 @@ class Broker:
                     )
 
             key = self.plan_cache.key(script, func, func_args, default_limit,
-                                      ("reg", topo_epoch))
+                                      ("reg", topo_epoch), tenant=tenant)
             q, entry, plan_cache_hit = self.plan_cache.get_query(key, _compile)
         if q.mutations:
             # Deploy tracepoints to every live agent and wait for readiness
@@ -778,6 +861,13 @@ class Broker:
             ctx = _QueryCtx(set(dp.agent_plans), set(dp.channels))
             ctx.configure_folds(dp, reg)
             self._queries[req_id] = ctx
+        # Degradation hints ride each execute frame: past the shed
+        # watermark, matview hits serve standing state WITHOUT folding
+        # their delta (stale-while-revalidate) and the agents' chunk ack
+        # window narrows so producers throttle at the source.  Read at
+        # dispatch time (not admit time) so a queue that drained while
+        # this query waited dispatches at full quality.
+        degraded = self.serving.enabled() and self.serving.degraded()
         try:
             for agent_name, plan in dp.agent_plans.items():
                 conn = self._agent_conns.get(agent_name)
@@ -796,10 +886,18 @@ class Broker:
                     "qtoken": ctx.token,
                     "trace": tctx,
                     "analyze": analyze,
+                    # tenant rides to the agents: matview state namespaces
+                    # per tenant under PL_TENANT_ISOLATION
+                    "tenant": tenant,
                     # distributed fan-out: agents route CPU/TPU by the
                     # query's total size, not their local shard's
                     "route_scale": len(dp.agent_plans),
                 }
+                if degraded:
+                    meta["stale_ok"] = True
+                    dw = int(_flags.get("PL_SERVING_DEGRADED_WINDOW"))
+                    if dw > 0:
+                        meta["stream_window"] = dw
                 # splice the cached plan JSON (encoded once per plan/split,
                 # not per query) instead of re-serializing the plan dict
                 pj = split_extras["plan_json"].get(agent_name)
@@ -886,6 +984,17 @@ class Broker:
                 #: split work?  (PL_QUERY_FASTPATH off ⇒ both always False)
                 stats["fastpath"] = {"plan_cache_hit": plan_cache_hit,
                                      "split_cache_hit": split_hit}
+                #: serving-front observability per query: its tenant, the
+                #: queue wait it paid, and whether it dispatched degraded
+                #: (stale matview serving + narrowed ack window)
+                stats["serving"] = {
+                    "tenant": tenant,
+                    "queued_ms": (round(ticket.wait_ns / 1e6, 3)
+                                  if ticket is not None and ticket.queued
+                                  else 0.0),
+                    "cost": ticket.cost if ticket is not None else None,
+                    "degraded": degraded,
+                }
                 if mv_keys:
                     served = {
                         a: s["matview"] for a, s in ctx.agent_stats.items()

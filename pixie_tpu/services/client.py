@@ -17,7 +17,13 @@ from pixie_tpu.types import ColumnSchema, Relation
 
 
 class QueryError(PxError):
-    pass
+    """Query failed at the broker.  `retry_after_s` is non-None when the
+    failure was an admission-control shed (back off and retry); None means
+    a real error (compile/exec/timeout) that retrying won't fix."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class _Pending:
@@ -26,15 +32,23 @@ class _Pending:
         self.stats: dict = {}
         self.schemas: Optional[dict] = None
         self.error: Optional[str] = None
+        self.retry_after_s: Optional[float] = None
         self.done = threading.Event()
 
 
 class Client:
-    """Blocking client (the pxapi Conn analog)."""
+    """Blocking client (the pxapi Conn analog).
+
+    `tenant` identifies this client to the broker's admission controller
+    (quotas, fair-share scheduling, per-tenant cache namespaces); it rides
+    every execute_script frame and can be overridden per call.
+    """
 
     def __init__(self, host: str, port: int, timeout_s: float = 120.0,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.timeout_s = timeout_s
+        self.tenant = tenant
         self._pending: dict[str, _Pending] = {}
         self._lock = threading.Lock()
         self._req = 0
@@ -65,6 +79,8 @@ class Client:
             p.done.set()
         elif msg == "error":
             p.error = meta.get("error", "unknown error")
+            ra = meta.get("retry_after_s")
+            p.retry_after_s = float(ra) if ra is not None else None
             p.done.set()
 
     def _on_close(self, conn: Connection):
@@ -86,6 +102,7 @@ class Client:
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False, funcs=None,
+        tenant: Optional[str] = None,
     ) -> dict[str, QueryResult]:
         """funcs=[(prefix, func_name, func_args)] runs a multi-widget
         request as ONE fused broker query; results key by fused sink name,
@@ -97,13 +114,14 @@ class Client:
                 "func": func, "func_args": func_args, "now": now,
                 "default_limit": default_limit, "analyze": analyze,
                 "funcs": [list(f) for f in funcs] if funcs else None,
+                "tenant": tenant if tenant is not None else self.tenant,
             }))
             if not ok:
                 raise Unavailable("broker connection closed")
             if not p.done.wait(timeout=self.timeout_s):
                 raise Unavailable(f"query timed out after {self.timeout_s}s")
             if p.error:
-                raise QueryError(p.error)
+                raise QueryError(p.error, retry_after_s=p.retry_after_s)
             out: dict[str, QueryResult] = {}
             for table, hb in p.chunks:
                 meta_rel = getattr(hb, "wire_meta", {}).get("relation")

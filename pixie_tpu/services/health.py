@@ -8,8 +8,14 @@ listener so probes need no protocol client or credentials.
 GET /healthz  → 200 `{"ok": true, "checks": {...}}` when every registered
 check passes, else 503 with the failing checks' errors (liveness).
 GET /readyz   → same over checks + ready_checks (readiness — e.g. leader
-election: a healthy standby is alive but not ready).
+election or serving-front overload: a healthy standby / a broker past its
+queue-depth watermark is alive but must not receive traffic).
 GET /metrics  → the Prometheus-style text rendering of pixie_tpu.metrics.
+
+The liveness/readiness split matters operationally: a k8s liveness probe
+restarts a failing pod, a readiness probe only pulls it from the service
+endpoints — an overloaded broker that fails BOTH gets restarted in a loop
+and sheds its queues, so overload may only ever flip /readyz.
 """
 from __future__ import annotations
 
@@ -59,6 +65,11 @@ class HealthzServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
+
+    def add_ready_check(self, name: str, fn: Callable) -> None:
+        """Register a READINESS-ONLY check: failing it flips /readyz while
+        /healthz stays green (overload, leadership, warmup...)."""
+        self.ready_checks[name] = fn
 
     def run_checks(self, ready: bool = False) -> tuple[bool, dict]:
         checks = dict(self.checks)

@@ -188,6 +188,16 @@ def encode_json(meta: dict) -> bytes:
     return _frame("json", meta, [])
 
 
+def encode_error(req_id, error, retry_after_s=None) -> bytes:
+    """The error envelope, optionally carrying an admission-control
+    retry-after hint (seconds).  Clients surface `retry_after_s` so a shed
+    query backs off instead of hammering a saturated broker."""
+    meta = {"msg": "error", "req_id": req_id, "error": str(error)}
+    if retry_after_s is not None:
+        meta["retry_after_s"] = round(float(retry_after_s), 3)
+    return _frame("json", meta, [])
+
+
 def encode_json_raw(meta: dict, raw_fields: dict[str, str]) -> bytes:
     """encode_json with PRE-SERIALIZED JSON values spliced in as extra
     top-level meta keys.

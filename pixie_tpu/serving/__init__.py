@@ -1,0 +1,43 @@
+"""Multi-tenant serving front: admission control + fair-share scheduling.
+
+The broker is the one chokepoint every ExecuteScript passes through
+(Pixie's L3 query_broker orchestrating the agent fleet, PAPER.md layer
+map); this package is what it absorbs in-cluster so a burst of queries —
+or one heavy tenant — cannot take the fleet down or starve interactive
+users:
+
+  admission.py  — per-tenant token-bucket quotas (PL_TENANT_QPS,
+                  PL_TENANT_CONCURRENCY), quota-spec parsing, ShedError
+                  (the retry-after envelope)
+  scheduler.py  — ServingFront: global in-flight cap, bounded per-tenant
+                  queues, deficit-round-robin dispatch weighted by tenant
+                  share and estimated query cost (plan-cache warm vs cold
+                  compile), degradation state (readyz flip, cold-query
+                  shedding, stale matview serving, narrowed chunk ack
+                  windows)
+  load_bench.py — closed-loop load harness: hundreds of concurrent
+                  mixed-tenant clients against a real broker+agents
+                  deployment, reporting p50/p99, goodput, shed rate and
+                  per-tenant fairness (the `serving_load` bench config)
+
+Flag-off (`PL_SERVING_ENABLED=0`) the front is a pass-through: no
+accounting, no queueing, bit-identical results.
+"""
+from pixie_tpu.serving.admission import (
+    COST_COLD,
+    COST_WARM,
+    ShedError,
+    TokenBucket,
+    parse_tenant_spec,
+)
+from pixie_tpu.serving.scheduler import ServingFront, Ticket
+
+__all__ = [
+    "COST_COLD",
+    "COST_WARM",
+    "ServingFront",
+    "ShedError",
+    "Ticket",
+    "TokenBucket",
+    "parse_tenant_spec",
+]

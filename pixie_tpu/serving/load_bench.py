@@ -1,0 +1,357 @@
+"""Closed-loop multi-tenant load harness (the `serving_load` bench config).
+
+Hundreds of logical clients in closed loops (each issues its next query the
+moment the previous one completes or sheds) drive a REAL broker + agent
+deployment over the framed-TCP transport — the full serving path: tenant
+admission, DRR dispatch, distributed execution, chunked streaming, merge.
+
+The tenant mix is the adversarial shape the serving front exists for:
+
+  * N interactive tenants with identical demand issuing the same WARM
+    dashboard script (plan-cache + matview hits) — the fairness population:
+    goodput max/min across them is the reported `fairness_ratio`.
+  * one `batch` tenant flooding COLD queries (a unique filter constant per
+    query defeats the plan cache, so every one pays compile + split) with
+    MORE clients than its bounded admission queue — its overflow sheds
+    with retry-after, which is the `shed_rate`; clients back off and retry
+    (the closed loop includes the backoff, as a real client would).
+  * a tiny `mut` tenant issuing tracepoint-deploy MUTATION queries on a
+    slow cadence — each deploy re-registers agents and bumps the topology
+    epoch, so warm tenants periodically re-pay a cold compile (the p99
+    tail carries it).
+  * an ingest writer appending rows to every agent store throughout, so
+    warm matview hits fold real deltas instead of polling empty cursors.
+
+Reported: per-tenant and aggregate p50/p99 latency, goodput (successful
+queries/s), shed and error rates, fairness ratio, peak admission-queue
+depth and in-flight, and RSS growth over the run (bounded queues + the
+chunk ack window are what keep it flat).  Everything is measured from the
+run — no modeled numbers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+WARM_SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.status != 404]
+df = df.groupby(['service', 'status']).agg(
+    cnt=('latency', px.count), avg_lat=('latency', px.mean),
+    p50=('latency', px.p50))
+px.display(df, 'out')
+"""
+
+#: cold queries: the {c} constant changes per issue, so the script text —
+#: and therefore the plan-cache key — never repeats
+COLD_SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.latency > {c}]
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mx=('latency', px.max))
+px.display(df, 'out')
+"""
+
+_TRACE_PROGRAM = r'''
+kprobe:tcp_drop
+{
+  $saddr = ntop(0);
+  $sport = 0;
+  printf("time_:%llu pid:%u src_ip:%s src_port:%d", nsecs, pid, $saddr, $sport);
+}
+'''
+
+MUTATION_SCRIPT = f'''
+import pxtrace
+import px
+
+program = """{_TRACE_PROGRAM}"""
+
+def probe():
+    pxtrace.UpsertTracepoint('load_probe', 'load_probe_table', program,
+                             pxtrace.kprobe(), "10m")
+    df = px.DataFrame(table='load_probe_table')
+    df = df.groupby('src_ip').agg(cnt=('pid', px.count))
+    return df
+'''
+
+
+def _mkstore(seed: int, rows: int):
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    rng = np.random.default_rng(seed)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING),
+        ("latency", DT.FLOAT64), ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=1 << 14, max_bytes=1 << 32)
+    svc = np.array([f"svc-{i}" for i in range(8)])
+    t.write({
+        "time_": np.arange(rows, dtype=np.int64) * 1000,
+        "service": svc[rng.integers(0, len(svc), rows)],
+        "latency": rng.exponential(20.0, rows),
+        "status": rng.choice([200, 404, 500], rows, p=[0.9, 0.05, 0.05]),
+    })
+    return ts
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:  # pragma: no cover — /proc-less platform
+        pass
+    return 0.0
+
+
+def _pct(xs: list, q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+class _TenantLoad:
+    """Accumulated per-tenant results (each client thread owns private
+    lists; merged single-threaded after join)."""
+
+    def __init__(self):
+        self.lat_s: list[float] = []
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+
+
+def run_load(clients: int = 560, duration_s: float = 8.0,
+             interactive_tenants: int = 3, rows: int = 100_000,
+             n_agents: int = 2, conns: int = 8,
+             queue_depth: int | None = None) -> dict:
+    """Drive the closed-loop mix; returns the serving_load result dict."""
+    from pixie_tpu import flags, metrics
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client, QueryError
+
+    # ---- tenant population: ~35% batch flood, rest split evenly ----------
+    batch_clients = max(4, int(clients * 0.35))
+    mut_clients = 2 if clients >= 40 else 1
+    per_interactive = max(1, (clients - batch_clients - mut_clients)
+                          // interactive_tenants)
+    if queue_depth is None:
+        # bounded so the batch flood OVERFLOWS (sheds) while each
+        # interactive tenant's closed-loop outstanding set fits
+        queue_depth = per_interactive + max(2, batch_clients // 3)
+    saved = {name: flags.get(name) for name in (
+        "PL_SERVING_ENABLED", "PL_SERVING_MAX_INFLIGHT",
+        "PL_SERVING_QUEUE_DEPTH", "PL_SERVING_QUEUE_TIMEOUT_S",
+        "PL_SERVING_SHED_WATERMARK")}
+    flags.set_for_testing("PL_SERVING_ENABLED", True)
+    flags.set_for_testing("PL_SERVING_MAX_INFLIGHT", 16)
+    flags.set_for_testing("PL_SERVING_QUEUE_DEPTH", queue_depth)
+    flags.set_for_testing("PL_SERVING_QUEUE_TIMEOUT_S", 60.0)
+    # closed-loop demand self-limits at `clients` outstanding; the watermark
+    # sits above it so degradation marks genuine open-loop floods, not this
+    # steady state (tests/test_serving.py exercises the degraded path)
+    flags.set_for_testing("PL_SERVING_SHED_WATERMARK", 2 * clients)
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0,
+                    healthz_port=0).start()
+    stores = {f"pem{i}": _mkstore(i + 1, rows) for i in range(n_agents)}
+    agents = [Agent(n, "127.0.0.1", broker.port, store=st,
+                    heartbeat_s=1.0).start() for n, st in stores.items()]
+    pool = [Client("127.0.0.1", broker.port, timeout_s=90.0)
+            for _ in range(conns)]
+    itenants = [f"tenant{i}" for i in range(interactive_tenants)]
+    loads: dict[str, _TenantLoad] = {
+        t: _TenantLoad() for t in [*itenants, "batch", "mut"]}
+
+    shed0 = sum(metrics.counter_series("px_serving_shed_total").values())
+    stale0 = metrics.counter_value("px_matview_stale_serves_total")
+
+    try:
+        # warm the interactive path: plan cache + matview standing state
+        for t in itenants:
+            for _ in range(3):
+                pool[0].execute_script(WARM_SCRIPT, tenant=t)
+        rss_base = _rss_mb()
+        rss_peak = [rss_base]
+        ready_flips = [0]
+        stop = threading.Event()
+        deadline = time.monotonic() + duration_s
+
+        def sampler():
+            import urllib.error
+            import urllib.request
+
+            while not stop.is_set():
+                rss_peak[0] = max(rss_peak[0], _rss_mb())
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{broker.healthz.port}/readyz",
+                        timeout=2.0).close()
+                except urllib.error.HTTPError:
+                    ready_flips[0] += 1  # 503 = alive but not ready
+                except Exception:
+                    pass
+                stop.wait(0.25)
+
+        def client_loop(idx: int, tenant: str, kind: str, out: list):
+            rng = np.random.default_rng(1000 + idx)
+            conn = pool[idx % len(pool)]
+            res = _TenantLoad()
+            out.append(res)
+            while time.monotonic() < deadline:
+                if kind == "warm":
+                    script = WARM_SCRIPT
+                elif kind == "cold":
+                    script = COLD_SCRIPT.format(
+                        c=round(float(rng.uniform(1, 500)), 6))
+                else:
+                    script = MUTATION_SCRIPT
+                t0 = time.perf_counter()
+                try:
+                    got = (conn.execute_script(script, tenant=tenant)
+                           if kind != "mut" else
+                           conn.execute_script(script, func="probe",
+                                               tenant=tenant))
+                    assert got
+                    res.lat_s.append(time.perf_counter() - t0)
+                    res.ok += 1
+                except QueryError as e:
+                    if e.retry_after_s is not None:
+                        res.shed += 1
+                        stop.wait(min(e.retry_after_s, 1.0))
+                    else:
+                        res.errors += 1
+                except Exception:
+                    res.errors += 1
+                if kind == "mut":
+                    stop.wait(1.5)  # mutations are rare control-plane events
+
+        def ingest_loop():
+            rngw = np.random.default_rng(7)
+            svc = np.array([f"svc-{i}" for i in range(8)])
+            n = 4096
+            while not stop.is_set():
+                for st in stores.values():
+                    t = st.table("http_events")
+                    t.write({
+                        "time_": np.full(n, time.time_ns(), dtype=np.int64),
+                        "service": svc[rngw.integers(0, len(svc), n)],
+                        "latency": rngw.exponential(20.0, n),
+                        "status": rngw.choice([200, 500], n),
+                    })
+                stop.wait(0.5)
+
+        threads = [threading.Thread(target=sampler, daemon=True),
+                   threading.Thread(target=ingest_loop, daemon=True)]
+        results: dict[str, list] = {t: [] for t in loads}
+        idx = 0
+        for t in itenants:
+            for _ in range(per_interactive):
+                threads.append(threading.Thread(
+                    target=client_loop, args=(idx, t, "warm", results[t]),
+                    daemon=True))
+                idx += 1
+        for _ in range(batch_clients):
+            threads.append(threading.Thread(
+                target=client_loop, args=(idx, "batch", "cold",
+                                          results["batch"]), daemon=True))
+            idx += 1
+        for _ in range(mut_clients):
+            threads.append(threading.Thread(
+                target=client_loop, args=(idx, "mut", "mut",
+                                          results["mut"]), daemon=True))
+            idx += 1
+        t_start = time.monotonic()
+        threads[0].start()
+        threads[1].start()
+        for th in threads[2:]:
+            th.start()
+        for th in threads[2:]:
+            th.join(timeout=120.0)
+        measured_s = time.monotonic() - t_start
+        stop.set()
+        threads[0].join(timeout=5.0)
+        threads[1].join(timeout=5.0)
+        for t, rs in results.items():
+            for r in rs:
+                loads[t].lat_s.extend(r.lat_s)
+                loads[t].ok += r.ok
+                loads[t].shed += r.shed
+                loads[t].errors += r.errors
+        front = broker.serving.stats()
+    finally:
+        for c in pool:
+            c.close()
+        for a in agents:
+            a.stop()
+        broker.stop()
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+
+    inter_lat = [s for t in itenants for s in loads[t].lat_s]
+    inter_ok = sum(loads[t].ok for t in itenants)
+    inter_attempts = sum(loads[t].ok + loads[t].shed + loads[t].errors
+                         for t in itenants)
+    qps = {t: loads[t].ok / measured_s for t in itenants}
+    fairness = (max(qps.values()) / max(min(qps.values()), 1e-9)
+                if qps else 0.0)
+    attempts = sum(v.ok + v.shed + v.errors for v in loads.values())
+    sheds = sum(v.shed for v in loads.values())
+    errors = sum(v.errors for v in loads.values())
+    return {
+        # `rows` = logical client count: the SHAPE key --check-regressions
+        # matches on, so a --smoke run never diffs against a full run
+        "rows": clients,
+        "clients": clients,
+        "duration_s": round(measured_s, 2),
+        "tenants": len(itenants) + 2,
+        "goodput_qps": round(sum(v.ok for v in loads.values()) / measured_s,
+                             1),
+        "interactive_qps": round(inter_ok / measured_s, 1),
+        "p50_ms": round(_pct(inter_lat, 0.50) * 1000, 1),
+        "p99_ms": round(_pct(inter_lat, 0.99) * 1000, 1),
+        "batch_p50_ms": round(_pct(loads["batch"].lat_s, 0.50) * 1000, 1),
+        "fairness_ratio": round(fairness, 3),
+        "shed_rate": round(sheds / max(attempts, 1), 4),
+        "shed_rate_interactive": round(
+            sum(loads[t].shed for t in itenants) / max(inter_attempts, 1), 4),
+        "error_rate": round(errors / max(attempts, 1), 4),
+        "shed_total": sheds,
+        "peak_queued": front["peak_queued"],
+        "peak_inflight": front["peak_inflight"],
+        "queue_bounded": bool(front["peak_queued"] <= clients),
+        "rss_base_mb": round(rss_base, 1),
+        "rss_growth_mb": round(max(rss_peak[0] - rss_base, 0.0), 1),
+        "readyz_unready_samples": ready_flips[0],
+        "stale_serves": int(
+            metrics.counter_value("px_matview_stale_serves_total") - stale0),
+        "shed_by_front": int(sum(
+            metrics.counter_series("px_serving_shed_total").values())
+            - shed0),
+    }
+
+
+def main(argv=None):  # pragma: no cover — exercised via bench.py
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=560)
+    ap.add_argument("--duration-s", type=float, default=8.0)
+    ap.add_argument("--rows", type=int, default=100_000)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_load(clients=args.clients,
+                              duration_s=args.duration_s,
+                              rows=args.rows), separators=(",", ":")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

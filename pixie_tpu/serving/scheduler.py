@@ -1,0 +1,372 @@
+"""ServingFront: the broker's admission gate + deficit-round-robin scheduler.
+
+Every ExecuteScript passes through `admit()` before any compile or dispatch
+work happens and through `release()` when it finishes.  Three outcomes:
+
+  * ADMIT — capacity is free (global in-flight below `PL_SERVING_MAX_INFLIGHT`
+    and the tenant below its own cap with nothing of its queued ahead): the
+    query proceeds immediately.
+  * QUEUE — capacity is busy: the query waits in its tenant's bounded FIFO
+    queue.  `release()` dispatches queued queries with deficit round robin
+    (Shreedhar & Varghese): each tenant accrues `quantum × weight` deficit
+    per scheduling round and dispatches when its head-of-line query's
+    estimated cost is covered, so a tenant flooding expensive cold compiles
+    drains slower than an interactive tenant issuing cheap warm queries —
+    by exactly the cost ratio — instead of starving it.
+  * SHED — the token bucket is dry (per-tenant QPS), the tenant queue is
+    full, the wait timed out, or the broker is past its degradation
+    watermark and the query is cold: `ShedError` carries a retry-after
+    hint back to the client.
+
+Degradation is a separate, observable state: total queue depth at or past
+`PL_SERVING_SHED_WATERMARK` flips `ready()` (the broker's /readyz check)
+while liveness stays green, sheds cold queries at the door, and marks
+dispatched queries `degraded` so the broker serves matview hits stale and
+narrows the chunk ack window (backpressure through the existing streaming
+protocol instead of unbounded frame queues).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from pixie_tpu import flags, metrics
+from pixie_tpu.serving.admission import (
+    COST_COLD,
+    ShedError,
+    TokenBucket,
+    spec_value,
+)
+
+#: deficit added per eligible tenant per scheduling round (cost units);
+#: weights multiply it, so a weight-2 tenant affords a COST_COLD query in
+#: half the rounds a weight-1 tenant does
+QUANTUM = 1.0
+
+
+def enabled() -> bool:
+    return bool(flags.get("PL_SERVING_ENABLED"))
+
+
+class Ticket:
+    """One admitted-or-queued query's pass through the front."""
+
+    __slots__ = ("tenant", "cost", "outcome", "event", "enqueue_ns",
+                 "wait_ns", "accounted", "degraded", "queued", "retry_after",
+                 "reason")
+
+    def __init__(self, tenant: str, cost: float):
+        self.tenant = tenant
+        self.cost = cost
+        self.outcome: Optional[str] = None  # run | shed (None = waiting)
+        self.event = threading.Event()
+        self.enqueue_ns = time.time_ns()
+        self.wait_ns = 0
+        self.accounted = False  # counted into inflight totals
+        self.degraded = False
+        self.queued = False
+        self.retry_after = 1.0
+        self.reason = ""
+
+
+class _TenantState:
+    __slots__ = ("name", "bucket", "max_conc", "weight", "inflight",
+                 "deficit", "queue")
+
+    def __init__(self, name: str):
+        self.name = name
+        rate = spec_value(flags.get("PL_TENANT_QPS"), name, float)
+        self.bucket = TokenBucket(rate) if rate else None
+        conc = spec_value(flags.get("PL_TENANT_CONCURRENCY"), name, int)
+        self.max_conc = int(conc) if conc else 0  # 0 = unlimited
+        # clamped: the dispatch loop's round budget is O(cost/min_weight)
+        # UNDER THE FRONT'S LOCK, so a configured weight of 1e-6 must not
+        # turn one dispatch into minutes of lock-held sweeping — 0.01 still
+        # deprioritizes a tenant 100:1 against the default
+        w = spec_value(flags.get("PL_TENANT_WEIGHTS"), name, float) or 1.0
+        self.weight = min(max(w, 0.01), 100.0)
+        self.inflight = 0
+        self.deficit = 0.0
+        self.queue: deque[Ticket] = deque()
+
+
+class ServingFront:
+    """Admission + fair-share scheduling state for one broker."""
+
+    def __init__(self, service: str = "broker"):
+        self.service = service
+        self._lock = threading.Lock()
+        self._tenants: dict[str, _TenantState] = {}
+        self._rr: list[str] = []  # stable DRR visit order
+        self._rr_idx = 0
+        self.inflight = 0
+        self.total_queued = 0
+        #: high-watermark latching for observability: peak queue depth and
+        #: peak inflight since start (the load harness asserts boundedness)
+        self.peak_queued = 0
+        self.peak_inflight = 0
+        self._labeled: set[str] = set()
+        self._gauges = False
+
+    #: idle tenant states above this count are pruned (a flood of distinct
+    #: tenant ids must not grow scheduler memory without bound; a pruned
+    #: tenant's next query simply re-reads its quota spec — the only state
+    #: lost is unused token-bucket burst and DRR deficit, both ≈ empty
+    #: when idle)
+    MAX_IDLE_TENANTS = 1024
+
+    #: distinct tenant ids that get their OWN metric label series; ids past
+    #: the cap share the "__other__" label — counter series in the metrics
+    #: registry are immortal, so an id flood must not grow them per tenant
+    #: the way the (pruned) scheduler states don't
+    MAX_LABELED_TENANTS = 256
+
+    def _label(self, tenant: str) -> str:
+        if tenant in self._labeled:
+            return tenant
+        if len(self._labeled) < self.MAX_LABELED_TENANTS:
+            self._labeled.add(tenant)
+            return tenant
+        return "__other__"
+
+    # ------------------------------------------------------------------ state
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            if len(self._tenants) >= self.MAX_IDLE_TENANTS:
+                idle = [n for n, s in self._tenants.items()
+                        if not s.queue and s.inflight == 0]
+                for n in idle[:max(1, len(idle) // 2)]:
+                    self._tenants.pop(n, None)
+                self._rr = [n for n in self._rr if n in self._tenants]
+                self._rr_idx = 0
+            st = self._tenants[tenant] = _TenantState(tenant)
+            self._rr.append(tenant)
+        return st
+
+    def enabled(self) -> bool:
+        return enabled()
+
+    def degraded(self) -> bool:
+        wm = int(flags.get("PL_SERVING_SHED_WATERMARK"))
+        return wm > 0 and self.total_queued >= wm
+
+    def ready(self) -> bool:
+        """Readiness: past the shed watermark the broker is alive but must
+        not receive new traffic (the /readyz check; /healthz stays green)."""
+        return not self.degraded()
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._tenants.clear()
+            self._rr.clear()
+            self._rr_idx = 0
+            self.inflight = self.total_queued = 0
+            self.peak_queued = self.peak_inflight = 0
+
+    # ------------------------------------------------------------------ admit
+    def admit(self, tenant: str, cost: float,
+              timeout_s: Optional[float] = None) -> Ticket:
+        """Gate one query.  Returns a Ticket (queued tickets block until
+        dispatched) or raises ShedError with a retry-after hint."""
+        t = Ticket(tenant, float(cost))
+        if not enabled():
+            return t  # pass-through: no accounting, release() is a no-op
+        cap = int(flags.get("PL_SERVING_MAX_INFLIGHT"))
+        depth = int(flags.get("PL_SERVING_QUEUE_DEPTH"))
+        with self._lock:
+            st = self._state(tenant)
+            if st.bucket is not None:
+                ra = st.bucket.try_take()
+                if ra > 0:
+                    self._shed_locked(t, "qps", ra)
+            if self.degraded() and cost >= COST_COLD:
+                self._shed_locked(t, "overload", self._retry_hint_locked(cap))
+            if (self.inflight < cap and not st.queue
+                    and (st.max_conc <= 0 or st.inflight < st.max_conc)):
+                self._run_locked(t, st)
+                return t
+            if len(st.queue) >= max(1, depth):
+                self._shed_locked(t, "queue_full",
+                                  self._retry_hint_locked(cap))
+            st.queue.append(t)
+            t.queued = True
+            self.total_queued += 1
+            self.peak_queued = max(self.peak_queued, self.total_queued)
+            metrics.counter_inc(
+                "px_serving_queued_total",
+                labels={"tenant": self._label(tenant)},
+                help_="queries that waited in the admission queue")
+            # capacity may be free with only tenant-cap-blocked queues (or a
+            # flag may have changed): give the new arrival a dispatch chance
+            self._dispatch_locked()
+        if timeout_s is None:
+            timeout_s = float(flags.get("PL_SERVING_QUEUE_TIMEOUT_S"))
+        if not t.event.wait(timeout=timeout_s):
+            with self._lock:
+                if t.outcome is None:  # still queued: pull it out and shed
+                    try:
+                        st.queue.remove(t)
+                        self.total_queued -= 1
+                    except ValueError:
+                        pass  # a dispatch raced the timeout; honor it below
+            if t.outcome is None:
+                self._shed(t, "timeout", self._retry_hint_locked(cap))
+            t.event.wait()  # raced dispatch: the outcome is set by now
+        t.wait_ns = time.time_ns() - t.enqueue_ns
+        if t.outcome == "shed":
+            raise ShedError(
+                f"tenant {tenant!r} shed ({t.reason}); "
+                f"retry after {t.retry_after:.2f}s",
+                retry_after_s=t.retry_after, reason=t.reason)
+        return t
+
+    def release(self, ticket: Optional[Ticket], ok: bool = True) -> None:
+        """Return a query's capacity and dispatch queued work."""
+        if ticket is None or not ticket.accounted:
+            return
+        ticket.accounted = False
+        with self._lock:
+            st = self._tenants.get(ticket.tenant)
+            self.inflight -= 1
+            if st is not None:
+                st.inflight -= 1
+            if ok:
+                metrics.counter_inc(
+                    "px_serving_tenant_goodput_queries_total",
+                    labels={"tenant": self._label(ticket.tenant)},
+                    help_="successfully completed queries per tenant")
+            self._dispatch_locked()
+
+    # --------------------------------------------------------------- internals
+    def _retry_hint_locked(self, cap: int) -> float:
+        # crude drain-time estimate: queued work over capacity, floored at
+        # 0.5s so clients don't hammer a saturated broker
+        return min(30.0, 0.5 + self.total_queued / max(1, cap))
+
+    def _shed(self, t: Ticket, reason: str, retry_after: float) -> None:
+        with self._lock:
+            self._shed_locked(t, reason, retry_after, raise_=False)
+
+    def _shed_locked(self, t: Ticket, reason: str, retry_after: float,
+                     raise_: bool = True):
+        t.outcome = "shed"
+        t.reason = reason
+        t.retry_after = round(max(retry_after, 0.05), 3)
+        t.event.set()
+        metrics.counter_inc(
+            "px_serving_shed_total",
+            labels={"tenant": self._label(t.tenant), "reason": reason},
+            help_="queries rejected by admission control")
+        metrics.counter_inc(
+            "px_serving_retry_after_total",
+            help_="shed responses that carried a retry-after hint")
+        if raise_:
+            raise ShedError(
+                f"tenant {t.tenant!r} shed ({reason}); "
+                f"retry after {t.retry_after:.2f}s",
+                retry_after_s=t.retry_after, reason=reason)
+
+    def _run_locked(self, t: Ticket, st: _TenantState) -> None:
+        t.outcome = "run"
+        t.accounted = True
+        t.degraded = self.degraded()
+        st.inflight += 1
+        self.inflight += 1
+        self.peak_inflight = max(self.peak_inflight, self.inflight)
+        t.event.set()
+        metrics.counter_inc(
+            "px_serving_admitted_total",
+            labels={"tenant": self._label(t.tenant)},
+            help_="queries admitted to execute")
+
+    def _eligible_locked(self, st: _TenantState) -> bool:
+        return bool(st.queue) and (st.max_conc <= 0
+                                   or st.inflight < st.max_conc)
+
+    def _dispatch_locked(self) -> None:
+        """Deficit round robin over tenant queues (lock held)."""
+        cap = int(flags.get("PL_SERVING_MAX_INFLIGHT"))
+        while self.inflight < cap:
+            eligible = [self._tenants[n] for n in self._rr
+                        if self._eligible_locked(self._tenants[n])]
+            if not eligible:
+                break
+            dispatched = False
+            # bounded top-up: each round adds QUANTUM × weight to every
+            # eligible tenant; the round budget and the deficit cap both
+            # scale with the SMALLEST eligible weight, so a fractional-
+            # weight tenant's cold query is merely slow to afford, never
+            # permanently unaffordable (a cap below COST_COLD would starve
+            # it forever — it would shed on timeout with a free broker)
+            min_w = min(st.weight for st in eligible)
+            rounds = int(COST_COLD / max(QUANTUM * min_w, 1e-6)) + 2
+            for _round in range(rounds):
+                n = len(self._rr)
+                for k in range(n):
+                    st = self._tenants[self._rr[(self._rr_idx + k) % n]]
+                    if (self._eligible_locked(st)
+                            and st.deficit >= st.queue[0].cost):
+                        t = st.queue.popleft()
+                        st.deficit -= t.cost
+                        if not st.queue:
+                            # classic DRR: an emptied queue forfeits its
+                            # unused deficit (no banking while idle)
+                            st.deficit = 0.0
+                        self.total_queued -= 1
+                        self._rr_idx = (self._rr_idx + k + 1) % n
+                        self._run_locked(t, st)
+                        dispatched = True
+                        break
+                if dispatched:
+                    break
+                for st in eligible:
+                    st.deficit = min(
+                        st.deficit + QUANTUM * st.weight,
+                        max(2.0 * COST_COLD * st.weight, COST_COLD))
+            if not dispatched:  # pragma: no cover — top-up bound guarantees
+                break
+
+    # ------------------------------------------------------------ observability
+    def queue_depths(self) -> dict[str, int]:
+        with self._lock:
+            return {n: len(st.queue) for n, st in self._tenants.items()}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": self.inflight,
+                "queued": self.total_queued,
+                "peak_inflight": self.peak_inflight,
+                "peak_queued": self.peak_queued,
+                "degraded": self.degraded(),
+                "tenants": {
+                    n: {"inflight": st.inflight, "queued": len(st.queue),
+                        "deficit": round(st.deficit, 3),
+                        "weight": st.weight}
+                    for n, st in self._tenants.items()
+                },
+            }
+
+    def attach_gauges(self) -> None:
+        if self._gauges:
+            return
+        self._gauges = True
+        metrics.register_gauge_fn(
+            "px_serving_queue_depth",
+            lambda: {(("tenant", n),): float(v)
+                     for n, v in self.queue_depths().items()} or {(): 0.0},
+            "admission queue depth per tenant")
+        metrics.register_gauge_fn(
+            "px_serving_inflight",
+            lambda: {(): float(self.inflight)},
+            "queries currently executing past admission")
+
+    def detach_gauges(self) -> None:
+        if not self._gauges:
+            return
+        self._gauges = False
+        metrics.unregister_gauge_fn("px_serving_queue_depth")
+        metrics.unregister_gauge_fn("px_serving_inflight")

@@ -221,6 +221,15 @@ def wire_context() -> Optional[dict]:
     return {"trace_id": c[1].trace_id, "span_id": c[1].span_id}
 
 
+def set_attr(**attributes) -> None:
+    """Stamp attributes onto the CURRENT span (no-op without an active
+    trace).  The broker uses it to mark the query root with its tenant and
+    admission outcome — facts only known after the root span opened."""
+    c = _CTX.get()
+    if c is not None:
+        c[1].attributes.update(attributes)
+
+
 def start_child(name: str, **attributes) -> Optional[Span]:
     """Child span of the current context that is NOT made current — for
     spans finished on another thread (e.g. per-agent dispatch spans closed

@@ -212,6 +212,85 @@ def test_wholeplan_unit_p50_guarded():
     assert bench.compare_bench(prior, now2, threshold=0.15) == []
 
 
+def _serving_doc(rows=560, goodput=60.0, p99=9000.0, fairness=1.2,
+                 shed_inter=0.0, err=0.0, rss=400.0, shed_total=40):
+    doc = _doc()
+    doc["configs"]["serving_load"] = {
+        "rows": rows, "clients": rows, "goodput_qps": goodput,
+        "p50_ms": 2500.0, "p99_ms": p99, "fairness_ratio": fairness,
+        "shed_rate": 0.05, "shed_rate_interactive": shed_inter,
+        "error_rate": err, "shed_total": shed_total,
+        "rss_growth_mb": rss, "queue_bounded": True,
+    }
+    return doc
+
+
+def test_serving_load_points_guarded():
+    """ISSUE-9: serving_load is a guarded goodput AND latency (p50 + p99)
+    point — the multi-tenant closed-loop path may not silently lose
+    throughput or grow its interactive tail."""
+    prior = _serving_doc()
+    pts = bench.bench_points(prior)
+    assert pts["configs.serving_load.goodput_qps"] == (60.0, 560)
+    lpts = bench.bench_latency_points(prior)
+    assert lpts["configs.serving_load.p99_ms"] == (9000.0, 560)
+    assert lpts["configs.serving_load.p50_ms"] == (2500.0, 560)
+    regs = bench.compare_bench(prior, _serving_doc(goodput=40.0),
+                               threshold=0.15)  # -33% goodput
+    assert "configs.serving_load.goodput_qps" in [r["key"] for r in regs]
+    regs = bench.compare_bench(prior, _serving_doc(p99=12_000.0),
+                               threshold=0.15)  # +33% p99
+    assert "configs.serving_load.p99_ms" in [r["key"] for r in regs]
+    # smoke shape (60 clients) never compares against the full 560 run
+    assert bench.compare_bench(prior, _serving_doc(rows=60, goodput=5.0,
+                                                   p99=20_000.0),
+                               threshold=0.15) == []
+
+
+def test_serving_load_absolute_ceilings_and_shed_floor():
+    """The serving acceptance criteria hold ABSOLUTELY at the full shape:
+    fairness ≤ 2.0, interactive shed rate / error budget / RSS growth
+    ceilings, and ≥1 shed (the bounded-queue proof — an oversized batch
+    flood that never overflowed means the bound wasn't enforced)."""
+    ok = _serving_doc()
+    assert bench.absolute_floors(ok) == []
+    bad = _serving_doc(fairness=2.4)
+    regs = bench.absolute_floors(bad)
+    assert [r["key"] for r in regs] == [
+        "configs.serving_load.fairness_ratio"]
+    assert regs[0]["ceiling"] == 2.0 and regs[0]["now"] == 2.4
+    assert "above ceiling" in bench._format_regression(regs[0])
+    assert bench.absolute_floors(_serving_doc(shed_inter=0.5))
+    assert bench.absolute_floors(_serving_doc(err=0.1))
+    assert bench.absolute_floors(_serving_doc(rss=4096.0))
+    regs = bench.absolute_floors(_serving_doc(shed_total=0))
+    assert [r["key"] for r in regs] == ["configs.serving_load.shed_total"]
+    # ceilings are violations through compare_bench too (the CI entry)
+    assert bench.compare_bench(_serving_doc(), _serving_doc(fairness=2.4),
+                               threshold=0.15)
+    # smoke shapes trip neither floors nor ceilings
+    assert bench.absolute_floors(
+        _serving_doc(rows=60, fairness=3.0, shed_total=0)) == []
+
+
+def test_serving_load_harness_crash_fails_guards():
+    """A crashed harness returns {rows, error} — at the guarded shape that
+    must TRIP every absolute bound (missing keys), not silently disable
+    the serving CI coverage."""
+    doc = _doc()
+    doc["configs"]["serving_load"] = {"rows": 560,
+                                      "error": "RuntimeError: boom"}
+    regs = bench.absolute_floors(doc)
+    assert len(regs) == len(bench.ABS_CEILINGS) + 1  # +1 shed_total floor
+    assert all(r.get("missing") for r in regs)
+    assert all(r["key"].startswith("configs.serving_load") for r in regs)
+    assert "missing at guarded shape" in bench._format_regression(regs[0])
+    assert "boom" in bench._format_regression(regs[0])
+    # a smoke-shape crash doesn't (smoke isn't guarded)
+    doc["configs"]["serving_load"] = {"rows": 60, "error": "boom"}
+    assert bench.absolute_floors(doc) == []
+
+
 def test_budget_json_line_sheds_diagnostics_keeps_headline():
     """The stdout line must fit the driver's ~2000-char tail cap
     (BENCH_r05's line outgrew it and the round parsed as null): the
