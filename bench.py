@@ -568,6 +568,26 @@ def bench_serving_load(clients, duration_s=8.0, rows=100_000):
     return {k: out[k] for k in keep if k in out}
 
 
+def bench_chaos_recovery(queries, rows=200_000):
+    """`chaos_recovery`: replay a fixed retryable query set against a real
+    broker+agent deployment under an injected agent kill-and-restart
+    schedule (services/chaos_bench.py).  The guard block holds the
+    acceptance ABSOLUTELY: recovery_rate == 1.0 and bit_equal_frac == 1.0
+    (every recovered answer BIT-equal to the fault-free baseline),
+    client_errors == 0, and the added p99 of recovery bounded."""
+    from pixie_tpu.services.chaos_bench import run_chaos
+
+    try:
+        out = run_chaos(queries=queries, rows=rows)
+    except Exception as e:  # the bench round must survive a harness failure
+        return {"rows": queries, "error": f"{type(e).__name__}: {e}"[:200]}
+    keep = ("rows", "queries", "kills", "recovery_rate", "bit_equal_frac",
+            "client_errors", "added_p99_ms", "baseline_p99_ms",
+            "chaos_p99_ms", "broker_retries", "evictions", "hedged",
+            "chunks_discarded", "client_retries")
+    return {k: out[k] for k in keep if k in out}
+
+
 def _device_busy(fn):
     """Measured production-run occupancy (engine/xprof.py) — a real
     jax.profiler trace on accelerator backends, XLA-CPU pool run-state
@@ -757,6 +777,8 @@ def main():
     ap.add_argument("--dist-rows", type=int, default=16_000_000)
     ap.add_argument("--serving-clients", type=int, default=560,
                     help="concurrent closed-loop clients for serving_load")
+    ap.add_argument("--chaos-queries", type=int, default=80,
+                    help="replayed queries for the chaos_recovery config")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
     ap.add_argument("--quick", action="store_true", help="small-but-real shapes")
     ap.add_argument("--repeats", type=int, default=3)
@@ -778,12 +800,14 @@ def main():
         args.rows, args.sweep = 200_000, "200000"
         args.stream_rows, args.join_rows, args.dist_rows = 400_000, 200_000, 200_000
         args.serving_clients = 60
+        args.chaos_queries = 16
     elif args.quick:
         args.rows, args.sweep = 4_000_000, "1000000,4000000"
         args.stream_rows, args.join_rows, args.dist_rows = (
             4_000_000, 2_000_000, 2_000_000,
         )
         args.serving_clients = 160
+        args.chaos_queries = 40
 
     from pixie_tpu.table import TableStore
 
@@ -831,6 +855,7 @@ def main():
     interactive, wholeplan = bench_interactive(min(args.rows, 1_000_000),
                                                args.repeats)
     serving = bench_serving_load(args.serving_clients)
+    chaos = bench_chaos_recovery(args.chaos_queries)
     sharded = bench_sharded_agg(args.rows, args.repeats)
     cfg3, cfg3_busy = bench_config3(args.join_rows, args.repeats)
     dj_rows = min(args.join_rows, 16_000_000)
@@ -869,6 +894,7 @@ def main():
             "interactive_1m": interactive,
             "wholeplan_native_unit": wholeplan,
             "serving_load": serving,
+            "chaos_recovery": chaos,
             "sharded_agg_64m": sharded,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
@@ -1112,6 +1138,14 @@ def compare_bench(prior, current, threshold):
 ABS_FLOORS = [
     ("configs.interactive_1m.vs_pandas", 5.0, 1_000_000),
     ("configs.serving_load.shed_total", 1.0, 560),
+    # chaos_recovery acceptance (ISSUE 10): every retryable query under the
+    # injected kill-and-restart schedule recovers, and every recovered
+    # answer is BIT-equal to the fault-free baseline
+    ("configs.chaos_recovery.recovery_rate", 1.0, 80),
+    ("configs.chaos_recovery.bit_equal_frac", 1.0, 80),
+    # the schedule must actually have killed agents — a run where nothing
+    # died proves nothing
+    ("configs.chaos_recovery.kills", 1.0, 80),
 ]
 
 #: absolute ceilings (key path, ceiling, shape rows) — the serving
@@ -1124,6 +1158,11 @@ ABS_CEILINGS = [
     ("configs.serving_load.shed_rate_interactive", 0.25, 560),
     ("configs.serving_load.error_rate", 0.02, 560),
     ("configs.serving_load.rss_growth_mb", 2048.0, 560),
+    # zero client-visible errors under chaos, and recovery costs bounded
+    # added tail latency (kill → restart → re-register → re-dispatch; the
+    # ceiling is backoff rounds + one re-execution, never an open stall)
+    ("configs.chaos_recovery.client_errors", 0.0, 80),
+    ("configs.chaos_recovery.added_p99_ms", 5000.0, 80),
 ]
 
 

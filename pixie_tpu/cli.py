@@ -156,14 +156,32 @@ def cmd_run(args) -> int:
         runs = [(out, fn, fargs) for out, fn, fargs in vis.executions(overrides)]
 
     if args.broker:
-        from pixie_tpu.services.client import Client
+        import sys as _sys
+
+        from pixie_tpu.services.client import Client, QueryError
+        from pixie_tpu.status import Unavailable
 
         host, port = args.broker.rsplit(":", 1)
         client = Client(host, int(port), auth_token=args.auth_token,
                         tenant=getattr(args, "tenant", None))
-        execute = lambda fn, fargs: client.execute_script(  # noqa: E731
-            source, func=fn, func_args=fargs, analyze=args.analyze
-        )
+
+        def execute(fn, fargs):
+            # the client auto-retries idempotent scripts through agent
+            # evictions and broker restarts — surface the recovery as a
+            # one-line note (or a clean error), never a stack trace
+            try:
+                out = client.execute_script(
+                    source, func=fn, func_args=fargs, analyze=args.analyze)
+            except (QueryError, Unavailable) as e:
+                # Unavailable covers the reconnect path exhausting its
+                # budget (broker down past PL_CLIENT_RETRIES) and timeouts
+                n = client.last_retries
+                retried = f" (retried {n}x)" if n else ""
+                raise SystemExit(f"query failed{retried}: {e}") from None
+            if client.last_retries:
+                print(f"note: retried {client.last_retries}x after a "
+                      "transient broker/agent failure", file=_sys.stderr)
+            return out
     else:
         from pixie_tpu.collect.schemas import all_schemas
         from pixie_tpu.compiler import compile_pxl

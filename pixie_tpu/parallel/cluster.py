@@ -95,6 +95,78 @@ def _union_host_batches(batches: list[HostBatch]) -> HostBatch:
     return u.finish()
 
 
+class SourceKeyedFold:
+    """Per-producer fold accounting for one merge-input channel.
+
+    The fault-tolerant broker must be able to DISCARD one producer's
+    contribution after the fact — an evicted agent whose chunks partially
+    arrived, or the losing attempt of a hedged duplicate dispatch — without
+    poisoning the merge.  A single shared accumulator (PR 6's streaming
+    fold) cannot un-fold; this keys one sub-accumulator per source id
+    (``agent#attempt``), keeps the incremental-fold overlap per source, and
+    pays one cross-source combine at finish over the ACCEPTED sources only.
+
+    Accepted sources merge in sorted-source order (one accepted attempt per
+    agent), so the combine order — and therefore float state reductions —
+    is deterministic regardless of cross-agent arrival interleaving;
+    re-dispatched and hedged runs fold bit-equal to fault-free ones.
+    """
+
+    __slots__ = ("kind", "agg", "registry", "subs", "counts")
+
+    def __init__(self, kind: str, agg=None, registry=None):
+        self.kind = kind  # "agg_state" | "rows"
+        self.agg = agg
+        self.registry = registry
+        self.subs: dict[str, object] = {}
+        self.counts: dict[str, int] = {}
+
+    def add(self, src: str, payload) -> None:
+        from pixie_tpu.parallel.partial import PartialAggBatch, PartialAggFold
+
+        sub = self.subs.get(src)
+        if sub is None:
+            sub = self.subs[src] = (
+                PartialAggFold(self.agg, self.registry)
+                if self.kind == "agg_state" else HostBatchUnion())
+        if self.kind == "agg_state":
+            if not isinstance(payload, PartialAggBatch):
+                raise TypeError("expected agg_state payloads")
+        elif not isinstance(payload, HostBatch):
+            raise TypeError("expected row payloads")
+        sub.add(payload)
+        self.counts[src] = self.counts.get(src, 0) + 1
+
+    def count_for(self, src: str) -> int:
+        return self.counts.get(src, 0)
+
+    def discarded_chunks(self, accepted: set) -> int:
+        """Chunks folded into sources that did NOT win (evicted agents,
+        losing hedge attempts) — dropped idempotently at finish."""
+        return sum(n for s, n in self.counts.items() if s not in accepted)
+
+    def finish(self, accepted: set) -> HostBatch:
+        from pixie_tpu.parallel.partial import (
+            combine_partials,
+            finalize_partial,
+        )
+        from pixie_tpu.status import InvalidArgument
+
+        subs = [self.subs[s] for s in sorted(accepted) if s in self.subs]
+        if not subs:
+            raise InvalidArgument("SourceKeyedFold.finish: no accepted "
+                                  "sources folded")
+        if self.kind == "agg_state":
+            parts = [p for sub in subs for p in sub.raw_parts()]
+            acc = (parts[0] if len(parts) == 1
+                   else combine_partials(self.agg, parts, self.registry))
+            return finalize_partial(self.agg, acc, self.registry)
+        u = HostBatchUnion()
+        for sub in subs:
+            u.add(sub.finish())
+        return u.finish()
+
+
 class LocalCluster:
     """N agents with private table stores + one merger, in one process."""
 

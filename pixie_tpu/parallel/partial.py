@@ -280,6 +280,14 @@ class PartialAggFold:
                else combine_partials(self.agg, parts, self.registry))
         return finalize_partial(self.agg, acc, self.registry)
 
+    def raw_parts(self) -> list[PartialAggBatch]:
+        """The accumulated state WITHOUT finalizing — staged combines plus
+        the pending tail.  Lets a caller merge several independent folds
+        (one per producer) into one finalize: the fault-tolerant broker
+        keys folds per (agent, attempt) so a dead producer's fold is
+        droppable, then combines the accepted folds' raw parts."""
+        return self._staged + self._pending
+
 
 def _np_identity(dtype, op: str):
     d = np.dtype(dtype)

@@ -115,6 +115,9 @@ class Agent:
         if self.collector is not None:
             self.collector.start()
         self.conn = dial(*self.broker, on_frame=self._on_frame)
+        # fault-injection target (services/faultinject.py): chaos plans
+        # address this agent's broker link as "agent:<name>"
+        self.conn.label = f"agent:{self.name}"
         if self.auth_token is not None:
             self.conn.send(wire.encode_json(
                 {"msg": "auth", "token": self.auth_token}))
@@ -167,9 +170,13 @@ class Agent:
             # broker consumed (folded) one of our chunk frames: open the
             # in-flight window by one.  MUST stay on the read loop — it's a
             # lone semaphore release, and a thread per ack would cost more
-            # than the fold it acknowledges.
+            # than the fold it acknowledges.  Keyed per (req_id, attempt):
+            # a hedged duplicate dispatch runs concurrently with its twin
+            # and must not drain the twin's window.
+            key = (f"{payload.get('req_id', '')}"
+                   f"#{int(payload.get('attempt') or 0)}")
             with self._windows_lock:
-                sem = self._windows.get(payload.get("req_id", ""))
+                sem = self._windows.get(key)
             if sem is not None:
                 sem.release()
         elif msg == "reregister":
@@ -212,9 +219,12 @@ class Agent:
 
         req_id = meta.get("req_id", "")
         # echoed on every result frame; the broker drops frames whose token
-        # doesn't match the live query (per-query result-stream auth,
-        # reference carnotpb/carnot.proto:30-96)
+        # doesn't match the live dispatch (per-dispatch result-stream auth,
+        # reference carnotpb/carnot.proto:30-96).  `attempt` distinguishes
+        # re-dispatches and hedged duplicates of the same query.
         qtoken = meta.get("qtoken")
+        attempt = int(meta.get("attempt") or 0)
+        wkey = f"{req_id}#{attempt}"
         # cross-process trace context: parent this agent's exec spans under
         # the broker's dispatch span for the same query
         tctx = meta.get("trace")
@@ -229,7 +239,7 @@ class Agent:
         sem = threading.Semaphore(window) if window > 0 else None
         if sem is not None:
             with self._windows_lock:
-                self._windows[req_id] = sem
+                self._windows[wkey] = sem
         try:
             with cm:
                 plan = Plan.from_dict(meta["plan"])
@@ -272,7 +282,8 @@ class Agent:
                     counts[channel] = seq + 1
                     extra = {"msg": "chunk", "req_id": req_id,
                              "channel": channel, "seq": seq,
-                             "agent": self.name, "qtoken": qtoken}
+                             "agent": self.name, "qtoken": qtoken,
+                             "attempt": attempt}
                     if isinstance(payload, PartialAggBatch):
                         frame = wire.encode_partial_agg(payload, extra)
                     elif isinstance(payload, HostBatch):
@@ -291,7 +302,8 @@ class Agent:
 
             self.conn.send(wire.encode_json({
                 "msg": "exec_done", "req_id": req_id, "agent": self.name,
-                "qtoken": qtoken, "stats": _jsonable(stats),
+                "qtoken": qtoken, "attempt": attempt,
+                "stats": _jsonable(stats),
                 # per-channel chunk counts: the broker verifies its folds saw
                 # every frame (a dropped chunk must fail loudly, not merge a
                 # silently-partial answer)
@@ -301,12 +313,12 @@ class Agent:
             self._flush_trace()
             self.conn.send(wire.encode_json({
                 "msg": "exec_error", "req_id": req_id, "agent": self.name,
-                "qtoken": qtoken, "error": str(e),
+                "qtoken": qtoken, "attempt": attempt, "error": str(e),
             }))
         finally:
             if sem is not None:
                 with self._windows_lock:
-                    self._windows.pop(req_id, None)
+                    self._windows.pop(wkey, None)
 
     def _await_window(self, sem: Optional[threading.Semaphore]) -> bool:
         """Block until the in-flight chunk window opens; False on stall.

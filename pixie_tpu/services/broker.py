@@ -20,6 +20,7 @@ import threading
 import traceback
 from typing import Optional
 
+from pixie_tpu import flags as _flags
 from pixie_tpu import trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
@@ -48,40 +49,111 @@ QUERY_LATENCY_BOUNDS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
 #: merge and count; only their span detail is dropped)
 MAX_FOLD_EVENT_SPANS = 256
 
+_flags.define_int(
+    "PL_QUERY_RETRIES", 2,
+    "broker-side re-dispatch rounds after an agent eviction (heartbeat "
+    "expiry / mid-stream disconnect): surviving agents' folded results are "
+    "kept, the lost fragments re-plan onto the live agent set and re-"
+    "dispatch under fresh per-dispatch tokens; 0 restores fail-fast")
+_flags.define_int(
+    "PL_RETRY_BACKOFF_MS", 100,
+    "base for the jittered exponential backoff between re-dispatch rounds "
+    "(round i sleeps ~base*2^i, capped at 5s) — the window a killed-and-"
+    "restarted agent gets to re-register before its fragments re-plan "
+    "around it")
+_flags.define_bool(
+    "PL_HEDGE_ENABLED", True,
+    "straggler hedging: a dispatch outliving its per-agent service-time "
+    "deadline (EWMA/p99-derived) gets a duplicate dispatch; first answer "
+    "wins, the loser's chunks are discarded idempotently")
+_flags.define_int(
+    "PL_HEDGE_MIN_MS", 500,
+    "floor for the hedge deadline — never hedge a dispatch younger than "
+    "this, however fast the agent's history says it should be")
+_flags.define_float(
+    "PL_HEDGE_FACTOR", 3.0,
+    "hedge deadline = max(PL_HEDGE_MIN_MS, factor * p99_estimate) where "
+    "p99_estimate = service-time EWMA + 4 * EWMA(|deviation|)")
+_flags.define_float(
+    "PL_REJOIN_GRACE_S", 2.0,
+    "how long after an agent's death its shard counts as REJOINING: "
+    "dispatch (and re-dispatch) holds for it instead of silently planning "
+    "a reduced topology — a restarting pod re-registers within the grace; "
+    "past it the cluster serves the surviving agents' data (the reference "
+    "data-plane semantic).  Only active when PL_QUERY_RETRIES > 0")
+
+#: service-time samples required before hedging arms for an agent — a cold
+#: EWMA over one or two samples would hedge every slow compile
+HEDGE_MIN_SAMPLES = 8
+
+#: cap on the re-dispatch backoff and on the retry-after hint shipped with
+#: a retry-budget-exhausted error
+MAX_BACKOFF_MS = 5000.0
+
 
 class _QueryCtx:
-    def __init__(self, expected_agents: set[str], channels: set[str]):
+    """In-flight bookkeeping for one distributed query (or tracepoint
+    deploy round).
+
+    Fault-tolerant dispatch model: every `execute` frame is one DISPATCH,
+    identified by ``src = f"{agent}#{attempt}"`` and authenticated by its
+    OWN token (the per-query token of PR 1, narrowed per dispatch).  Chunk
+    frames fold into per-src accumulators (`parallel.cluster.
+    SourceKeyedFold`), so an evicted agent's partial stream — or the losing
+    side of a hedged duplicate dispatch — is discarded at merge simply by
+    never ACCEPTING its src; nothing is un-folded and late/duplicate chunks
+    land in sub-folds nobody reads (idempotent discard).  The first
+    exec_done per agent wins (`accepted[agent] = src`)."""
+
+    def __init__(self, channels: set[str], retryable: bool = True):
         import secrets
 
-        self.payloads: dict[str, list] = {c: [] for c in channels}
-        self.pending_agents = set(expected_agents)
-        self.agent_stats: dict[str, dict] = {}
+        self.lock = threading.RLock()
+        #: False for tracepoint-deploy rounds: agent loss fails the round
+        #: immediately (mutations are never transparently re-dispatched)
+        self.retryable = retryable
         self.error: Optional[str] = None
         self.done = threading.Event()
-        #: per-agent dispatch spans (trace.Span), opened at frame send and
-        #: closed by the exec_done/exec_error handler threads
-        self.dispatch_spans: dict[str, object] = {}
-        #: per-query auth token: agents must echo it on every result chunk
-        #: and completion frame, so a stale/confused/malicious producer
-        #: cannot inject rows into another query's stream (reference: the
-        #: broker injects a per-query auth token into GRPCSinks and the
-        #: result-sink server validates it, carnotpb/carnot.proto:30-96)
+        #: nudges the query thread: completion, eviction, or error
+        self.wake = threading.Event()
+        #: base token — tracepoint deploy rounds dispatch under it directly
         self.token = secrets.token_urlsafe(12)
+        #: agents whose answer the current plan requires
+        self.needed_agents: set[str] = set()
+        #: src → {agent, attempt, frag, deadline, hedged, t0}
+        self.pending: dict[str, dict] = {}
+        #: src → per-dispatch auth token (never pruned within a query: late
+        #: frames from a losing/evicted src must validate so their discard
+        #: is COUNTED as a discard, not mistaken for a stale-query frame)
+        self.tokens: dict[str, str] = {}
+        #: agent → winning src (first exec_done)
+        self.accepted: dict[str, str] = {}
+        #: src → the fragment JSON it was dispatched with (re-dispatch
+        #: keeps an accepted result only when its fragment is unchanged
+        #: under the re-planned split)
+        self.frags: dict[str, Optional[str]] = {}
+        self.next_attempt: dict[str, int] = {}
+        #: (agent, reason) eviction events awaiting the query thread
+        self.evictions: list[tuple] = []
+        #: one hedge per agent per dispatch round
+        self.hedged_agents: set[str] = set()
+        #: per-src dispatch spans, opened at frame send and closed by the
+        #: exec_done/exec_error handler threads (or eviction cleanup)
+        self.dispatch_spans: dict[str, object] = {}
+        self.agent_stats: dict[str, dict] = {}
         # ---- streaming incremental merge (set up by configure_folds) ----
-        #: channel id → PartialAggFold | HostBatchUnion: chunk frames fold
-        #: into these AS THEY ARRIVE (reader threads), so merge work hides
-        #: under the slowest agent's compute; channels without a fold (join
-        #: bucket channels) accumulate in `payloads` as before
+        #: channel id → SourceKeyedFold: chunk frames fold into per-src
+        #: sub-accumulators AS THEY ARRIVE (reader threads), so merge work
+        #: hides under the slowest agent's compute AND a src is droppable
         self.folds: dict[str, object] = {}
         #: per-channel locks: fold.add serializes across agent reader
-        #: threads (the accumulators are not thread-safe), but folds on
-        #: DISTINCT channels share no state — a heavy agg combine on one
-        #: channel must not stall another channel's folds and acks
+        #: threads, but folds on DISTINCT channels share no state
         self.fold_locks: dict[str, threading.Lock] = {}
-        #: channel → chunks folded / expected (expected accumulates from the
-        #: per-agent counts on exec_done frames)
-        self.folded_chunks: dict[str, int] = {}
-        self.expected_chunks: dict[str, int] = {}
+        #: join-stage bucket channels accumulate whole payload lists per
+        #: src; the stage runner consumes the accepted srcs' lists at merge
+        self.bucket_payloads: dict[str, dict[str, list]] = {}
+        #: (channel, src) → chunks the producer reported on exec_done
+        self.expected_chunks: dict[tuple, int] = {}
         #: (start_unix_ns, duration_ns, channel, agent) per fold, emitted as
         #: incremental_fold spans at merge time (the reader threads hold no
         #: trace context); capped — first_fold_ns/last_terminal_ns carry the
@@ -91,24 +163,177 @@ class _QueryCtx:
         self.last_terminal_ns: Optional[int] = None
 
     def configure_folds(self, dp, registry) -> None:
-        """Arm one incremental accumulator per merge-input channel.  Must run
-        before the first `execute` frame is sent (chunks race the dispatch
-        loop); join-stage bucket channels keep list accumulation — the stage
-        runner consumes whole per-partition lists at merge time."""
-        from pixie_tpu.parallel.cluster import HostBatchUnion
-        from pixie_tpu.parallel.partial import PartialAggFold
+        """Arm one source-keyed accumulator per merge-input channel.  Must
+        run before the first `execute` frame is sent (chunks race the
+        dispatch loop); join-stage bucket channels keep list accumulation —
+        the stage runner consumes whole per-partition lists at merge time."""
+        from pixie_tpu.parallel.cluster import SourceKeyedFold
         from pixie_tpu.parallel.repartition import bucket_channels
 
         consumed = bucket_channels(dp)
         for cid, ch in dp.channels.items():
             if cid in consumed:
                 continue
-            if ch.kind == "agg_state":
-                self.folds[cid] = PartialAggFold(ch.agg, registry)
-            else:
-                self.folds[cid] = HostBatchUnion()
+            self.folds[cid] = SourceKeyedFold(ch.kind, agg=ch.agg,
+                                              registry=registry)
             self.fold_locks[cid] = threading.Lock()
 
+    # ------------------------------------------------------- dispatch state
+    @staticmethod
+    def src_of(meta: dict) -> str:
+        return f"{meta.get('agent')}#{int(meta.get('attempt') or 0)}"
+
+    def register_dispatch(self, agent: str, frag=None, deadline=None,
+                          hedged: bool = False, token: Optional[str] = None):
+        import secrets
+        import time as _time
+
+        with self.lock:
+            attempt = self.next_attempt.get(agent, 0)
+            self.next_attempt[agent] = attempt + 1
+            src = f"{agent}#{attempt}"
+            self.tokens[src] = token or secrets.token_urlsafe(12)
+            self.frags[src] = frag
+            self.pending[src] = {
+                "agent": agent, "attempt": attempt, "frag": frag,
+                "deadline": deadline, "hedged": hedged,
+                "t0": _time.monotonic(),
+            }
+            if hedged:
+                self.hedged_agents.add(agent)
+            return src, self.tokens[src], attempt
+
+    def drop_dispatch(self, src: str) -> None:
+        with self.lock:
+            self.pending.pop(src, None)
+            self.tokens.pop(src, None)
+
+    def token_for(self, src: str) -> Optional[str]:
+        with self.lock:
+            return self.tokens.get(src)
+
+    def frag_of(self, src: str) -> Optional[str]:
+        return self.frags.get(src)
+
+    def outstanding_agents(self) -> list[str]:
+        with self.lock:
+            return sorted(self.needed_agents - set(self.accepted))
+
+    def uncovered_agents(self) -> list[str]:
+        """Needed agents with neither an accepted result nor an in-flight
+        dispatch — the set a re-dispatch round must cover."""
+        with self.lock:
+            covered = set(self.accepted)
+            covered.update(i["agent"] for i in self.pending.values())
+            return sorted(self.needed_agents - covered)
+
+    def _check_done(self) -> None:
+        # callers hold self.lock
+        if self.error is not None or self.needed_agents <= set(self.accepted):
+            self.done.set()
+        self.wake.set()
+
+    def fail(self, error: str) -> None:
+        with self.lock:
+            if self.error is None:
+                self.error = error
+            self._check_done()
+
+    # --------------------------------------- producer frames (reader threads)
+    def on_exec_done(self, meta: dict):
+        """Returns (agent, service_seconds) when this frame ACCEPTED the
+        agent's result; None for stale or hedge-losing frames."""
+        import time as _time
+
+        src = self.src_of(meta)
+        with self.lock:
+            self.last_terminal_ns = _time.time_ns()
+            info = self.pending.pop(src, None)
+            if info is None:
+                return None
+            agent = info["agent"]
+            if agent in self.accepted:
+                # a hedge raced: first answer already won — this src's
+                # chunks are discarded at merge (never accepted)
+                self._check_done()
+                return None
+            self.accepted[agent] = src
+            self.agent_stats[agent] = meta.get("stats", {})
+            for cid, n in (meta.get("chunks") or {}).items():
+                self.expected_chunks[(cid, src)] = int(n)
+            self._check_done()
+            return agent, _time.monotonic() - info["t0"]
+
+    def on_exec_error(self, meta: dict) -> Optional[str]:
+        """Returns the fatal error when no other live attempt can still
+        answer for this agent; None when a hedge twin is outstanding or
+        the frame is stale."""
+        src = self.src_of(meta)
+        with self.lock:
+            info = self.pending.pop(src, None)
+            if info is None:
+                return None
+            agent = info["agent"]
+            if agent in self.accepted:
+                return None
+            if any(i["agent"] == agent for i in self.pending.values()):
+                return None  # the hedged twin may still answer
+            err = f"agent {meta.get('agent')}: {meta.get('error')}"
+            if self.error is None:
+                self.error = err
+            self._check_done()
+            return err
+
+    def on_agent_lost(self, agent: str, reason: str) -> list[str]:
+        """Connection/liveness loss: drop the agent's in-flight dispatches
+        and queue an eviction for the query thread (or fail outright for
+        non-retryable rounds).  Returns dropped srcs for span cleanup.  An
+        agent whose result was already accepted is a no-op — its data is
+        folded and verified; its later death cannot poison this query."""
+        with self.lock:
+            srcs = [s for s, i in self.pending.items() if i["agent"] == agent]
+            for s in srcs:
+                self.pending.pop(s, None)
+            affected = bool(srcs) or (agent in self.needed_agents
+                                      and agent not in self.accepted)
+            if not affected:
+                self.wake.set()
+                return srcs
+            if not self.retryable:
+                if self.error is None:
+                    self.error = f"agent {agent} disconnected mid-query"
+                self._check_done()
+                return srcs
+            self.evictions.append((agent, reason))
+            self.wake.set()
+            return srcs
+
+    def take_evictions(self) -> list[tuple]:
+        with self.lock:
+            ev, self.evictions = self.evictions, []
+            return ev
+
+    def reset_for_restart(self, dp, registry) -> None:
+        """Full re-dispatch: the re-planned channel topology changed (e.g.
+        a repartition join lost its widest mesh), so every fold so far is
+        unusable.  Fresh tokens mean frames from superseded dispatches are
+        rejected (and counted) rather than folded."""
+        with self.lock:
+            self.pending.clear()
+            self.tokens.clear()
+            self.accepted.clear()
+            self.frags = {}
+            self.expected_chunks = {}
+            self.agent_stats = {}
+            self.folds = {}
+            self.fold_locks = {}
+            self.bucket_payloads = {}
+            self.configure_folds(dp, registry)
+            self.needed_agents = set(dp.agent_plans)
+            self.hedged_agents = set()
+            self.done.clear()
+
+    # ------------------------------------------- chunk folds (reader threads)
     def fold_chunk(self, meta: dict, payload) -> None:
         """Fold one producer chunk frame; called from connection reader
         threads.  A malformed chunk fails the QUERY (error + done), never
@@ -116,34 +341,39 @@ class _QueryCtx:
         import time as _time
 
         cid = meta["channel"]
+        src = self.src_of(meta)
         fold = self.folds.get(cid)
-        if fold is None:
-            self.payloads.setdefault(cid, []).append(payload)
-            return
-        from pixie_tpu.parallel.cluster import HostBatchUnion
-        from pixie_tpu.parallel.partial import PartialAggBatch, PartialAggFold
-
         t0 = _time.time_ns()
         try:
+            if fold is None:
+                with self.lock:
+                    self.bucket_payloads.setdefault(cid, {}).setdefault(
+                        src, []).append(payload)
+                return
             with self.fold_locks[cid]:
-                if isinstance(fold, PartialAggFold):
-                    if not isinstance(payload, PartialAggBatch):
-                        raise TypeError(
-                            f"channel {cid}: expected agg_state payloads")
-                elif isinstance(fold, HostBatchUnion):
-                    if not isinstance(payload, HostBatch):
-                        raise TypeError(f"channel {cid}: expected row payloads")
-                fold.add(payload)
-                self.folded_chunks[cid] = self.folded_chunks.get(cid, 0) + 1
+                fold.add(src, payload)
         except Exception as e:
-            self.error = f"chunk fold failed on channel {cid}: {e}"
-            self.done.set()
+            self.fail(f"chunk fold failed on channel {cid}: {e}")
             return
         if self.first_fold_ns is None:
             self.first_fold_ns = t0
         if len(self.fold_events) < MAX_FOLD_EVENT_SPANS:
             self.fold_events.append(
                 (t0, _time.time_ns() - t0, cid, meta.get("agent")))
+
+
+def _channels_compatible(dp, dp2) -> bool:
+    """Whether a re-planned split can reuse the folds of the original: the
+    channel set/kinds, join stages (incl. partition counts), and the merger
+    plan must be identical — producer lists may differ (that is the point
+    of re-planning around a dead agent)."""
+    a, b = dp.to_dict(), dp2.to_dict()
+    ak = {cid: (c["kind"], _json.dumps(c["agg"], sort_keys=True))
+          for cid, c in a["channels"].items()}
+    bk = {cid: (c["kind"], _json.dumps(c["agg"], sort_keys=True))
+          for cid, c in b["channels"].items()}
+    return (ak == bk and a["merger_plan"] == b["merger_plan"]
+            and a["join_stages"] == b["join_stages"])
 
 
 class Broker:
@@ -195,6 +425,12 @@ class Broker:
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
+        #: per-agent service-time model for straggler hedging: EWMA of
+        #: dispatch→exec_done seconds + EWMA of |deviation| (a cheap p99
+        #: estimate: ewma + 4*dev); warmed by HEDGE_MIN_SAMPLES before a
+        #: hedge deadline arms
+        self._svc: dict[str, dict] = {}
+        self._svc_lock = threading.Lock()
         self._req_counter = 0
         self._stopped = threading.Event()
         self._expiry_thread = threading.Thread(
@@ -352,19 +588,30 @@ class Broker:
             elif msg == "register":
                 self._handle_register(conn, payload)
             elif msg == "heartbeat":
+                if self._stale_incarnation(conn):
+                    return  # a superseded socket's heartbeat must not keep
+                    # the NEW incarnation's record warm
                 if not self.registry.heartbeat(payload["agent"]):
                     conn.send(wire.encode_json({"msg": "reregister"}))
             elif msg == "tracepoint_ready":
+                if self._stale_incarnation(conn):
+                    return
                 self._handle_exec_done({
                     "req_id": payload.get("req_id"),
                     "qtoken": payload.get("qtoken"),
                     "agent": payload.get("agent"), "stats": {},
                 })
             elif msg == "tracepoint_error":
+                if self._stale_incarnation(conn):
+                    return
                 self._handle_exec_error(payload)
             elif msg == "exec_done":
+                if self._stale_incarnation(conn):
+                    return
                 self._handle_exec_done(payload)
             elif msg == "exec_error":
+                if self._stale_incarnation(conn):
+                    return
                 self._handle_exec_error(payload)
             elif msg == "execute_script":
                 threading.Thread(
@@ -421,8 +668,10 @@ class Broker:
                 conn.send(wire.encode_json({"msg": "error", "error": f"unknown msg {msg!r}"}))
         else:
             # data chunk from an agent (host_batch | partial_agg)
+            if self._stale_incarnation(conn):
+                return
             meta = payload.wire_meta
-            self._handle_chunk(meta, payload)
+            self._handle_chunk(conn, meta, payload)
 
     @staticmethod
     def _reply_ack(conn: Connection, payload: dict, fn) -> None:
@@ -436,16 +685,55 @@ class Broker:
             }))
 
     def _on_close(self, conn: Connection):
+        if conn.state.get("superseded"):
+            # a newer incarnation already owns the name: marking it dead
+            # here would kill the NEW agent's liveness (dead stays dead
+            # until register), and its eviction already ran at supersede
+            return
         name = conn.state.get("agent")
         if name is not None:
             self.registry.mark_dead(name)
-            self._agent_conns.pop(name, None)
-            # fail this agent's pending queries (producer watchdog analog)
-            with self._qlock:
-                for ctx in self._queries.values():
-                    if name in ctx.pending_agents:
-                        ctx.error = f"agent {name} disconnected mid-query"
-                        ctx.done.set()
+            if self._agent_conns.get(name) is conn:
+                self._agent_conns.pop(name, None)
+            # producer watchdog analog: evict the agent from every pending
+            # query — retryable queries re-plan + re-dispatch, tracepoint
+            # deploy rounds (and PL_QUERY_RETRIES=0) fail fast
+            self._evict_agent(name, "disconnected")
+
+    def _stale_incarnation(self, conn: Connection) -> bool:
+        """Incarnation fence: frames arriving on a connection registered
+        under an OLDER incarnation of the agent name are dropped (counted).
+        A restarted agent re-registering under the same name supersedes the
+        old socket; whatever that socket still delivers — chunks, acks,
+        heartbeats — must be rejected, not folded."""
+        name = conn.state.get("agent")
+        inc = conn.state.get("incarnation")
+        if name is None or inc is None:
+            return False
+        if inc == self.registry.incarnation(name):
+            return False
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.counter_inc(
+            "px_broker_stale_incarnation_frames_total",
+            help_="frames dropped from superseded agent sockets (an agent "
+                  "re-registered under the same name; the old incarnation "
+                  "is fenced)")
+        return True
+
+    def _evict_agent(self, name: str, reason: str) -> None:
+        from pixie_tpu import metrics as _metrics
+
+        _metrics.counter_inc(
+            "px_agent_evictions_total",
+            help_="agent connections lost (disconnect, heartbeat expiry, "
+                  "or supersede by a re-registration)")
+        with self._qlock:
+            ctxs = list(self._queries.values())
+        for ctx in ctxs:
+            for src in ctx.on_agent_lost(name, reason):
+                self._finish_dispatch_span(ctx, src,
+                                           error=f"agent {name} {reason}")
 
     # ---------------------------------------------------------------- handlers
     def _handle_register(self, conn: Connection, meta: dict):
@@ -453,45 +741,57 @@ class Broker:
         schemas = {t: Relation.from_dict(r) for t, r in meta["schemas"].items()}
         asid = self.registry.register(name, schemas, meta.get("n_devices"))
         conn.state["agent"] = name
+        # the incarnation this socket speaks for — older sockets for the
+        # same name are fenced from here on (_stale_incarnation)
+        conn.state["incarnation"] = self.registry.incarnation(name)
         old = self._agent_conns.get(name)
-        if old is not None and old is not conn:
-            old.state.pop("agent", None)  # superseded; don't let its close kill the new one
-            old.close()
         self._agent_conns[name] = conn
         conn.send(wire.encode_json({"msg": "registered", "asid": asid}))
+        if old is not None and old is not conn:
+            # keep "agent"+"incarnation" on the old conn so frames its
+            # reader already queued are FENCED (stale incarnation) rather
+            # than processed; the superseded marker keeps its close from
+            # killing the new registration
+            old.state["superseded"] = True
+            old.close()
+            # in-flight dispatches on the old socket are orphaned (the new
+            # process never saw them): evict so they re-dispatch to the
+            # fresh incarnation
+            self._evict_agent(name, "superseded")
 
     def _ctx(self, meta: dict) -> Optional[_QueryCtx]:
         """Resolve the query ctx for a producer frame, enforcing the
-        per-query token.  Mismatched/missing tokens are dropped (and
-        counted): a stale producer must not corrupt a newer query that
-        reused context state."""
+        per-dispatch token.  Mismatched/missing tokens are dropped (and
+        counted): a stale producer must not corrupt a newer query — or a
+        newer dispatch round — that reused context state."""
         import hmac
 
         with self._qlock:
             ctx = self._queries.get(meta.get("req_id", ""))
         if ctx is None:
             return None
+        expect = ctx.token_for(_QueryCtx.src_of(meta))
         # utf-8 bytes operands: compare_digest raises TypeError on non-ASCII
         # str, which would skip the counted-drop path (same pitfall the auth
         # handler avoids)
-        if not hmac.compare_digest(
-                str(meta.get("qtoken", "")).encode(), ctx.token.encode()):
+        if expect is None or not hmac.compare_digest(
+                str(meta.get("qtoken", "")).encode(), expect.encode()):
             from pixie_tpu import metrics as _metrics
 
             _metrics.counter_inc(
                 "px_broker_stale_token_frames_total",
-                help_="producer frames rejected for a bad per-query token")
+                help_="producer frames rejected for a bad per-dispatch token")
             # surfaced loudly: an agent that never echoes the token (e.g. a
             # version mismatch) would otherwise present as an opaque query
             # timeout with only a metric to explain it
             _metrics.warn(
-                "dropping producer frame with bad per-query token",
+                "dropping producer frame with bad per-dispatch token",
                 req_id=meta.get("req_id"), agent=meta.get("agent"),
                 has_token=bool(meta.get("qtoken")))
             return None
         return ctx
 
-    def _handle_chunk(self, meta: dict, payload):
+    def _handle_chunk(self, conn: Connection, meta: dict, payload):
         ctx = self._ctx(meta)
         if ctx is not None:
             ctx.fold_chunk(meta, payload)
@@ -500,45 +800,70 @@ class Broker:
         # the agents instead of queueing unbounded frames.  Acked even when
         # the query is already dead (ctx None / stale token): acks are pure
         # flow control, and a producer still draining a doomed stream must
-        # not stall on a window nobody will ever open.
-        conn = self._agent_conns.get(meta.get("agent", ""))
-        if conn is not None and not conn.closed:
+        # not stall on a window nobody will ever open.  Replied on the SAME
+        # connection the chunk arrived on — routing by agent name would ack
+        # a restarted incarnation for its predecessor's frames.
+        if not conn.closed:
             conn.send(wire.encode_json({
                 "msg": "chunk_ack", "req_id": meta.get("req_id"),
                 "channel": meta["channel"], "seq": meta.get("seq"),
+                "attempt": meta.get("attempt"),
             }))
 
-    def _finish_dispatch_span(self, ctx: _QueryCtx, agent,
+    def _finish_dispatch_span(self, ctx: _QueryCtx, src,
                               error: Optional[str] = None) -> None:
-        sp = ctx.dispatch_spans.pop(agent, None)
+        sp = ctx.dispatch_spans.pop(src, None)
         if sp is not None:
             if error:
                 sp.attributes["error"] = error[:200]
             self.tracer.finish(sp)
 
-    def _handle_exec_done(self, meta: dict):
-        import time as _time
+    def _record_service_time(self, agent: str, secs: float) -> None:
+        """Fold one dispatch→exec_done sample into the agent's EWMA model
+        (hedge deadlines derive from it)."""
+        a = 0.2
+        with self._svc_lock:
+            s = self._svc.get(agent)
+            if s is None:
+                self._svc[agent] = {"ewma": secs, "dev": secs / 2, "n": 1}
+                return
+            s["ewma"] += a * (secs - s["ewma"])
+            s["dev"] += a * (abs(secs - s["ewma"]) - s["dev"])
+            s["n"] += 1
 
+    def _hedge_deadline_s(self, agent: str) -> Optional[float]:
+        """Seconds a dispatch to `agent` may run before a hedged duplicate
+        fires; None while the service-time model is cold (or hedging off)."""
+        if not _flags.get("PL_HEDGE_ENABLED"):
+            return None
+        with self._svc_lock:
+            s = self._svc.get(agent)
+            if s is None or s["n"] < HEDGE_MIN_SAMPLES:
+                return None
+            p99 = s["ewma"] + 4.0 * s["dev"]
+        return max(float(_flags.get("PL_HEDGE_MIN_MS")) / 1e3,
+                   float(_flags.get("PL_HEDGE_FACTOR")) * p99)
+
+    def _handle_exec_done(self, meta: dict):
         ctx = self._ctx(meta)
         if ctx is None:
             return
-        ctx.agent_stats[meta["agent"]] = meta.get("stats", {})
-        ctx.last_terminal_ns = _time.time_ns()
-        for cid, n in (meta.get("chunks") or {}).items():
-            ctx.expected_chunks[cid] = ctx.expected_chunks.get(cid, 0) + int(n)
-        self._finish_dispatch_span(ctx, meta["agent"])
-        ctx.pending_agents.discard(meta["agent"])
-        if not ctx.pending_agents:
-            ctx.done.set()
+        src = _QueryCtx.src_of(meta)
+        res = ctx.on_exec_done(meta)
+        self._finish_dispatch_span(ctx, src)
+        # non-retryable rounds are tracepoint deploys: their round-trip
+        # measures apply+re-register, not query execution — folding them
+        # into the hedge model would skew the straggler deadlines
+        if res is not None and ctx.retryable:
+            self._record_service_time(*res)
 
     def _handle_exec_error(self, meta: dict):
         ctx = self._ctx(meta)
         if ctx is None:
             return
-        ctx.error = f"agent {meta.get('agent')}: {meta.get('error')}"
-        self._finish_dispatch_span(ctx, meta.get("agent"),
-                                   error=str(meta.get("error")))
-        ctx.done.set()
+        src = _QueryCtx.src_of(meta)
+        ctx.on_exec_error(meta)
+        self._finish_dispatch_span(ctx, src, error=str(meta.get("error")))
 
     # ------------------------------------------------------------------- query
     def _run_query(self, client: Connection, meta: dict):
@@ -584,7 +909,13 @@ class Broker:
         except Exception as e:  # compile/plan/exec errors all surface to client
             if not isinstance(e, PxError):
                 traceback.print_exc()
-            client.send(wire.encode_error(req_id, e))
+            # infrastructure failures on idempotent queries carry the
+            # retryable marker (+ a retry-after hint) so clients auto-retry
+            # instead of surfacing a one-off agent death to the user
+            client.send(wire.encode_error(
+                req_id, e,
+                retry_after_s=getattr(e, "retry_after_s", None),
+                retryable=getattr(e, "retryable", None)))
         finally:
             self._ship_spans()
 
@@ -640,7 +971,13 @@ class Broker:
             with self._qlock:
                 self._req_counter += 1
                 rid = f"tp{self._req_counter}"
-                ctx = _QueryCtx(set(targets), set())
+                # retryable=False: mutations are never transparently
+                # re-dispatched — agent loss mid-deploy fails the round
+                ctx = _QueryCtx(set(), retryable=False)
+                ctx.needed_agents = set(targets)
+                for name in targets:
+                    # deploy acks ride the base token at attempt 0
+                    ctx.register_dispatch(name, token=ctx.token)
                 self._queries[rid] = ctx
             try:
                 for conn in targets.values():
@@ -650,13 +987,280 @@ class Broker:
                     }))
                 if not ctx.done.wait(timeout=self.query_timeout_s):
                     raise Unavailable(
-                        f"tracepoint deploy timed out on {sorted(ctx.pending_agents)}"
+                        f"tracepoint deploy timed out on "
+                        f"{ctx.outstanding_agents()}"
                     )
                 if ctx.error:
                     raise Unavailable(ctx.error)
             finally:
                 with self._qlock:
                     self._queries.pop(rid, None)
+
+    # ------------------------------------------------- fault-tolerant dispatch
+    def _await_rejoin_grace(self) -> None:
+        """Hold dispatch while a just-dead agent may still re-register: a
+        query planned in the kill→restart window would otherwise silently
+        answer from the surviving shards only.  Bounded by the grace window
+        measured from each death (never the full query timeout); a no-op
+        with retries disabled — PL_QUERY_RETRIES=0 keeps the legacy
+        plan-with-whatever-is-live behavior bit-identically."""
+        import time as _time
+
+        if int(_flags.get("PL_QUERY_RETRIES")) <= 0:
+            return
+        grace = float(_flags.get("PL_REJOIN_GRACE_S"))
+        if grace <= 0:
+            return
+        deadline = _time.monotonic() + min(grace, self.query_timeout_s)
+        waited_for = None
+        t0 = _time.time_ns()
+        while _time.monotonic() < deadline:
+            recent = self.registry.recently_dead(grace)
+            if not recent:
+                break
+            waited_for = recent
+            _time.sleep(0.05)
+        if waited_for is not None:
+            trace.event_span("rejoin_wait", t0, _time.time_ns() - t0,
+                             agents=",".join(waited_for))
+
+    def _send_execute(self, ctx: _QueryCtx, req_id: str, agent: str,
+                      plan_json: str, base_meta: dict,
+                      hedged: bool = False) -> str:
+        """Send one execute dispatch (fragment `plan_json`) to `agent` under
+        a fresh per-dispatch token.  Returns the src id; raises Unavailable
+        when the agent has no live connection."""
+        from pixie_tpu.status import Unavailable
+
+        conn = self._agent_conns.get(agent)
+        if conn is None or conn.closed:
+            raise Unavailable(f"agent {agent} not connected")
+        deadline = None
+        if not hedged:
+            h = self._hedge_deadline_s(agent)
+            if h is not None:
+                import time as _time
+
+                deadline = _time.monotonic() + h
+        src, token, attempt = ctx.register_dispatch(
+            agent, frag=plan_json, deadline=deadline, hedged=hedged)
+        # one dispatch span per src: opened at send, closed by the
+        # exec_done/exec_error handler (or eviction cleanup); its id rides
+        # the wire so the agent's exec spans parent under it cross-process
+        dsp = trace.start_child("dispatch", agent=agent, attempt=attempt,
+                                hedged=hedged)
+        tctx = None
+        if dsp is not None:
+            ctx.dispatch_spans[src] = dsp
+            tctx = {"trace_id": dsp.trace_id, "span_id": dsp.span_id}
+        meta = dict(base_meta)
+        meta.update({"req_id": req_id, "qtoken": token, "attempt": attempt,
+                     "trace": tctx})
+        # splice the cached plan JSON (encoded once per plan/split, not per
+        # query) instead of re-serializing the plan dict
+        if not conn.send(wire.encode_json_raw(meta, {"plan": plan_json})):
+            ctx.drop_dispatch(src)
+            self._finish_dispatch_span(ctx, src, error="send failed")
+            raise Unavailable(f"agent {agent} not connected")
+        return src
+
+    def _await_agents(self, ctx: _QueryCtx, req_id: str, entry, q, dp,
+                      split_extras, base_meta: dict, reg, fault: dict,
+                      retries: int):
+        """Wait for every needed agent's answer, surviving evictions and
+        stragglers: evicted fragments re-plan onto the live agent set and
+        re-dispatch with jittered exponential backoff (bounded by
+        PL_QUERY_RETRIES); dispatches outliving their service-time deadline
+        get a hedged duplicate.  Returns the final (dp, split_extras) —
+        re-dispatch may have re-planned them."""
+        import random as _random
+        import time as _time
+
+        from pixie_tpu import metrics as _metrics
+        from pixie_tpu.status import CompilerError, Unavailable
+
+        backoff_ms = float(_flags.get("PL_RETRY_BACKOFF_MS"))
+        rng = _random.Random()
+        deadline = _time.monotonic() + self.query_timeout_s
+        rounds = 0
+        while True:
+            if ctx.error:
+                raise Unavailable(ctx.error)
+            if ctx.done.is_set():
+                return dp, split_extras
+            evicted = ctx.take_evictions()
+            fault["evictions"] += len(evicted)
+            if evicted or ctx.uncovered_agents():
+                names = (sorted({a for a, _ in evicted})
+                         or ctx.uncovered_agents())
+                if rounds >= retries:
+                    err = Unavailable(
+                        f"agent {names[0]} disconnected mid-query")
+                    if not q.mutations:
+                        # infrastructure loss, not a query bug: the client
+                        # may retry once the agent re-registers
+                        err.retryable = True
+                        err.retry_after_s = min(
+                            backoff_ms * (2 ** rounds), MAX_BACKOFF_MS) / 1e3
+                    raise err
+                rounds += 1
+                fault["rounds"] = rounds
+                _metrics.counter_inc(
+                    "px_query_retries_total",
+                    help_="query re-dispatch rounds after agent eviction")
+                # jittered exponential backoff: the window a killed-and-
+                # restarted agent gets to re-register before this round
+                # re-plans around it
+                delay = (backoff_ms * (2 ** (rounds - 1)) / 1e3
+                         * (0.5 + rng.random()))
+                delay = min(delay, MAX_BACKOFF_MS / 1e3,
+                            max(deadline - _time.monotonic(), 0.0))
+                if delay > 0:
+                    _time.sleep(delay)
+                t0 = _time.time_ns()
+                try:
+                    dp, split_extras = self._redispatch(
+                        ctx, req_id, entry, q, dp, split_extras, base_meta,
+                        reg, fault)
+                except (Unavailable, CompilerError):
+                    # the cluster cannot serve the query right now (e.g.
+                    # the killed agent has not re-registered): burn the
+                    # round and look again after the next backoff — the
+                    # uncovered set keeps this loop re-entering here
+                    continue
+                trace.event_span("redispatch", t0, _time.time_ns() - t0,
+                                 agents=",".join(names), round=rounds)
+                continue
+            nxt = self._maybe_hedge(ctx, req_id, base_meta, fault)
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                raise Unavailable(
+                    f"query timed out after {self.query_timeout_s}s waiting "
+                    f"for agents {ctx.outstanding_agents()}")
+            wait_s = min(0.25, remaining)
+            if nxt is not None:
+                wait_s = min(wait_s, max(nxt, 0.01))
+            ctx.wake.wait(timeout=wait_s)
+            ctx.wake.clear()
+
+    def _redispatch(self, ctx: _QueryCtx, req_id: str, entry, q, dp,
+                    split_extras, base_meta: dict, reg, fault: dict):
+        """One re-plan + re-dispatch round: re-split over the LIVE agent
+        set and dispatch every uncovered fragment under fresh tokens.
+        Accepted results (and in-flight dispatches) whose fragments are
+        unchanged are KEPT — only the lost work repeats.  Falls back to a
+        full restart when the channel topology changed (e.g. a repartition
+        join lost its widest mesh)."""
+        from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
+        from pixie_tpu.parallel.distributed import DistributedPlanner
+        from pixie_tpu.status import Unavailable
+
+        topo_epoch = self.registry.epoch
+        spec = self.registry.cluster_spec()
+        if not any(a.has_data_store for a in spec.agents):
+            raise Unavailable("no live data agents registered")
+        # a needed agent that died within the rejoin grace is REJOINING,
+        # not gone: re-planning around it now would silently answer from
+        # the surviving shards — burn the round and wait for it instead
+        grace = float(_flags.get("PL_REJOIN_GRACE_S"))
+        live = {a.name for a in spec.agents}
+        rejoining = [a for a in sorted(ctx.needed_agents)
+                     if a not in live
+                     and a in set(self.registry.recently_dead(grace))]
+        if rejoining:
+            raise Unavailable(
+                f"agent {rejoining[0]} re-registration pending")
+
+        def _split():
+            with trace.span("plan_split", redispatch=True):
+                dp2 = DistributedPlanner(spec).plan(q.plan)
+                extras = {"plan_json": {
+                    a: _json.dumps(p.to_dict())
+                    for a, p in dp2.agent_plans.items()
+                }}
+                return dp2, extras
+
+        (dp2, extras2), _hit = _QPC.get_split(
+            entry, ("split", topo_epoch), _split)
+        base_meta["route_scale"] = len(dp2.agent_plans)
+        if not _channels_compatible(dp, dp2):
+            # topology-shaped plan state (join partition counts, channel
+            # sets, the merger plan) changed: nothing folded so far is
+            # usable — restart the whole dispatch under fresh tokens
+            for src in list(ctx.dispatch_spans):
+                self._finish_dispatch_span(ctx, src, error="redispatched")
+            ctx.reset_for_restart(dp2, reg)
+        else:
+            with ctx.lock:
+                ctx.needed_agents = set(dp2.agent_plans)
+                # an accepted fragment that CHANGED under the new plan (or
+                # an in-flight dispatch of one) cannot be kept — its chunks
+                # answer a different question now
+                for agent in list(ctx.accepted):
+                    if (agent in dp2.agent_plans
+                            and ctx.frag_of(ctx.accepted[agent])
+                            != extras2["plan_json"][agent]):
+                        ctx.accepted.pop(agent)
+                for src, info in list(ctx.pending.items()):
+                    agent = info["agent"]
+                    if (agent not in dp2.agent_plans
+                            or info.get("frag")
+                            != extras2["plan_json"][agent]):
+                        ctx.pending.pop(src, None)
+                ctx.hedged_agents.clear()  # fresh round, fresh hedge budget
+                ctx._check_done()
+        for agent in ctx.uncovered_agents():
+            try:
+                self._send_execute(ctx, req_id, agent,
+                                   extras2["plan_json"][agent], base_meta)
+            except Unavailable:
+                # its conn raced away again — the uncovered set re-enters
+                # the retry loop for it
+                continue
+            if agent not in fault["redispatched"]:
+                fault["redispatched"].append(agent)
+        return dp2, extras2
+
+    def _maybe_hedge(self, ctx: _QueryCtx, req_id: str, base_meta: dict,
+                     fault: dict):
+        """Dispatch hedged duplicates for in-flight dispatches past their
+        straggler deadline (first answer wins; the loser's chunks are
+        discarded idempotently at merge).  Returns seconds until the next
+        armed deadline, or None when nothing is armed."""
+        if not _flags.get("PL_HEDGE_ENABLED"):
+            return None
+        import time as _time
+
+        from pixie_tpu import metrics as _metrics
+        from pixie_tpu.status import Unavailable
+
+        now = _time.monotonic()
+        soonest = None
+        with ctx.lock:
+            pend = [(s, dict(i)) for s, i in ctx.pending.items()]
+        for src, info in pend:
+            dl = info.get("deadline")
+            if dl is None or info.get("hedged"):
+                continue
+            agent = info["agent"]
+            with ctx.lock:
+                if agent in ctx.hedged_agents or agent in ctx.accepted:
+                    continue
+            if now < dl:
+                gap = dl - now
+                soonest = gap if soonest is None else min(soonest, gap)
+                continue
+            try:
+                self._send_execute(ctx, req_id, agent, info["frag"],
+                                   base_meta, hedged=True)
+            except Unavailable:
+                continue  # conn gone: the eviction path owns this agent now
+            fault["hedged"] += 1
+            _metrics.counter_inc(
+                "px_hedged_dispatches_total",
+                help_="duplicate dispatches sent for straggling agents "
+                      "(first answer wins)")
+        return soonest
 
     def _admit(self, script, func, func_args, default_limit, tenant):
         """Pass one query through the serving front's admission gate.
@@ -770,6 +1374,10 @@ class Broker:
             leader = self.elector.leader()
             raise Unavailable(
                 f"this broker is not the leader (current leader: {leader})")
+        # Hold for shards whose agent died moments ago and may re-register
+        # (kill-and-restart): planning through the gap would silently serve
+        # a reduced topology
+        self._await_rejoin_grace()
         # Epoch BEFORE cluster_spec: a registration landing between the two
         # reads must not let a split computed from the agent-less spec be
         # cached under the post-registration epoch (sticky wrong results).
@@ -779,7 +1387,12 @@ class Broker:
         topo_epoch = self.registry.epoch
         spec = self.registry.cluster_spec()
         if not any(a.has_data_store for a in spec.agents):
-            raise Unavailable("no live data agents registered")
+            e = Unavailable("no live data agents registered")
+            # nothing compiled, nothing executed: always safe to retry
+            # once an agent (re-)registers
+            e.retryable = True
+            e.retry_after_s = 1.0
+            raise e
         sink_map = None
         entry = None
         plan_cache_hit = False
@@ -845,20 +1458,11 @@ class Broker:
         # is off.
         import pixie_tpu.matview  # noqa: F401 — defines the PL_MATVIEW_* flags
 
-        from pixie_tpu import flags as _flags
-
-        mv_keys = {}
-        if _flags.get("PL_MATVIEW_ENABLED"):
-            from pixie_tpu.matview.registry import plan_view_key
-
-            mv_keys = {
-                name: k for name, plan in dp.agent_plans.items()
-                if (k := plan_view_key(plan, reg)) is not None
-            }
         with self._qlock:
             self._req_counter += 1
             req_id = f"q{self._req_counter}"
-            ctx = _QueryCtx(set(dp.agent_plans), set(dp.channels))
+            ctx = _QueryCtx(set(dp.channels))
+            ctx.needed_agents = set(dp.agent_plans)
             ctx.configure_folds(dp, reg)
             self._queries[req_id] = ctx
         # Degradation hints ride each execute frame: past the shed
@@ -868,51 +1472,53 @@ class Broker:
         # dispatch time (not admit time) so a queue that drained while
         # this query waited dispatches at full quality.
         degraded = self.serving.enabled() and self.serving.degraded()
+        base_meta = {
+            "msg": "execute",
+            "analyze": analyze,
+            # tenant rides to the agents: matview state namespaces
+            # per tenant under PL_TENANT_ISOLATION
+            "tenant": tenant,
+            # distributed fan-out: agents route CPU/TPU by the
+            # query's total size, not their local shard's
+            "route_scale": len(dp.agent_plans),
+        }
+        if degraded:
+            base_meta["stale_ok"] = True
+            dw = int(_flags.get("PL_SERVING_DEGRADED_WINDOW"))
+            if dw > 0:
+                base_meta["stream_window"] = dw
+        #: per-query fault/recovery ledger → stats["fault"]
+        fault = {"rounds": 0, "evictions": 0, "hedged": 0,
+                 "chunks_discarded": 0, "redispatched": []}
+        retries = int(_flags.get("PL_QUERY_RETRIES"))
         try:
-            for agent_name, plan in dp.agent_plans.items():
-                conn = self._agent_conns.get(agent_name)
-                if conn is None or conn.closed:
-                    raise Unavailable(f"agent {agent_name} not connected")
-                # one dispatch span per agent: opened at send, closed by the
-                # exec_done/exec_error handler; its id rides the wire so the
-                # agent's exec spans parent under it across processes
-                dsp = trace.start_child("dispatch", agent=agent_name)
-                tctx = None
-                if dsp is not None:
-                    ctx.dispatch_spans[agent_name] = dsp
-                    tctx = {"trace_id": dsp.trace_id, "span_id": dsp.span_id}
-                meta = {
-                    "msg": "execute", "req_id": req_id,
-                    "qtoken": ctx.token,
-                    "trace": tctx,
-                    "analyze": analyze,
-                    # tenant rides to the agents: matview state namespaces
-                    # per tenant under PL_TENANT_ISOLATION
-                    "tenant": tenant,
-                    # distributed fan-out: agents route CPU/TPU by the
-                    # query's total size, not their local shard's
-                    "route_scale": len(dp.agent_plans),
-                }
-                if degraded:
-                    meta["stale_ok"] = True
-                    dw = int(_flags.get("PL_SERVING_DEGRADED_WINDOW"))
-                    if dw > 0:
-                        meta["stream_window"] = dw
-                # splice the cached plan JSON (encoded once per plan/split,
-                # not per query) instead of re-serializing the plan dict
-                pj = split_extras["plan_json"].get(agent_name)
-                if pj is not None:
-                    conn.send(wire.encode_json_raw(meta, {"plan": pj}))
-                else:  # pragma: no cover — split always covers its agents
-                    meta["plan"] = plan.to_dict()
-                    conn.send(wire.encode_json(meta))
-            if dp.agent_plans and not ctx.done.wait(timeout=self.query_timeout_s):
-                raise Unavailable(
-                    f"query timed out after {self.query_timeout_s}s waiting for "
-                    f"agents {sorted(ctx.pending_agents)}"
-                )
+            for agent_name in dp.agent_plans:
+                pj = (split_extras["plan_json"].get(agent_name)
+                      or _json.dumps(dp.agent_plans[agent_name].to_dict()))
+                try:
+                    self._send_execute(ctx, req_id, agent_name, pj, base_meta)
+                except Unavailable:
+                    if retries <= 0 or q.mutations:
+                        raise
+                    # the retry loop below re-plans around (or waits out)
+                    # the missing agent
+                    with ctx.lock:
+                        ctx.evictions.append((agent_name, "not connected"))
+                        ctx.wake.set()
+            if dp.agent_plans:
+                dp, split_extras = self._await_agents(
+                    ctx, req_id, entry, q, dp, split_extras, base_meta,
+                    reg, fault, retries)
             if ctx.error:
                 raise Unavailable(ctx.error)
+            mv_keys = {}
+            if _flags.get("PL_MATVIEW_ENABLED"):
+                from pixie_tpu.matview.registry import plan_view_key
+
+                mv_keys = {
+                    name: k for name, plan in dp.agent_plans.items()
+                    if (k := plan_view_key(plan, reg)) is not None
+                }
 
             with trace.span("merge"):
                 from pixie_tpu.parallel.repartition import (
@@ -928,37 +1534,79 @@ class Broker:
                 for t0_ns, dur_ns, cid, agent in ctx.fold_events:
                     trace.event_span("incremental_fold", t0_ns, dur_ns,
                                      channel=cid, agent=agent)
+                # only the ACCEPTED sources (first answer per agent) merge;
+                # everything else — evicted agents' partial streams, losing
+                # hedge attempts, late duplicates — is discarded here and
+                # counted, never folded into the answer.  Losing/superseded
+                # producers may STILL be streaming into ctx on their reader
+                # threads, so every shared structure is read under its lock
+                # (an unguarded dict iteration here would raise mid-merge
+                # and fail a query that succeeded).
+                with ctx.lock:
+                    accepted_srcs = set(ctx.accepted.values())
+                    buckets = {cid: {s: list(chunks)
+                                     for s, chunks in by_src.items()}
+                               for cid, by_src in ctx.bucket_payloads.items()}
+                discarded = 0
+                payloads: dict[str, list] = {cid: [] for cid in dp.channels}
+                for cid, by_src in buckets.items():
+                    for s, chunks in sorted(by_src.items()):
+                        if s in accepted_srcs and cid in payloads:
+                            payloads[cid].extend(chunks)
+                        else:
+                            discarded += len(chunks)
                 if dp.join_stages:
                     # repartitioned joins run partition-parallel on the merger
                     # (the Kelvin role); bucket channels are consumed here, with
                     # the same payload-shape contract as rows channels
-                    run_join_stages(dp, ctx.payloads, reg,
+                    run_join_stages(dp, payloads, reg,
                                     store=self.merger_store, analyze=analyze)
                 consumed = bucket_channels(dp)
                 inputs: dict[str, HostBatch] = {}
+                folded_total = 0
                 for cid, ch in dp.channels.items():
                     if cid in consumed:
                         continue
                     fold = ctx.folds.get(cid)
-                    if fold is None or fold.count == 0:
+                    flock = ctx.fold_locks.get(cid)
+                    if fold is None or flock is None:
                         raise Internal(f"channel {cid} received no payloads")
-                    # every chunk an agent SENT must have folded: a dropped
-                    # frame means a silently-partial answer, so fail instead
-                    if cid in ctx.expected_chunks and (
-                            ctx.folded_chunks.get(cid, 0)
-                            != ctx.expected_chunks[cid]):
-                        raise Internal(
-                            f"channel {cid}: folded "
-                            f"{ctx.folded_chunks.get(cid, 0)} of "
-                            f"{ctx.expected_chunks[cid]} chunk frames")
-                    # the running fold already combined every chunk on
-                    # arrival; finish() only finalizes (agg) or pays the one
-                    # concatenation (rows)
-                    with trace.span("merge_finish", channel=cid,
-                                    kind=ch.kind, chunks=fold.count,
-                                    incremental=True):
-                        inputs[cid] = fold.finish()
-                inputs.update(stage_output_inputs(dp, ctx.payloads))
+                    # the channel's fold lock serializes against loser/
+                    # superseded producers still folding on reader threads
+                    with flock:
+                        total = sum(fold.count_for(s)
+                                    for s in accepted_srcs)
+                        if total == 0:
+                            raise Internal(
+                                f"channel {cid} received no payloads")
+                        # every chunk an accepted producer SENT must have
+                        # folded: a dropped frame means a silently-partial
+                        # answer, so fail instead
+                        for s in sorted(accepted_srcs):
+                            exp = ctx.expected_chunks.get((cid, s))
+                            if exp is not None and fold.count_for(s) != exp:
+                                raise Internal(
+                                    f"channel {cid}: folded "
+                                    f"{fold.count_for(s)} of "
+                                    f"{exp} chunk frames")
+                        folded_total += total
+                        discarded += fold.discarded_chunks(accepted_srcs)
+                        # the running per-src folds already combined every
+                        # chunk on arrival; finish() pays one cross-source
+                        # combine (deterministic sorted-source order) + the
+                        # finalize
+                        with trace.span("merge_finish", channel=cid,
+                                        kind=ch.kind, chunks=total,
+                                        incremental=True):
+                            inputs[cid] = fold.finish(accepted_srcs)
+                if discarded:
+                    _metrics.counter_inc(
+                        "px_chunks_discarded_total", float(discarded),
+                        help_="producer chunks discarded at merge (evicted "
+                              "agents' partial streams, losing hedge "
+                              "attempts, late duplicates)")
+                fault["chunks_discarded"] = discarded
+                inputs.update(stage_output_inputs(dp, payloads))
 
                 from pixie_tpu.udf.udtf import UDTFContext
 
@@ -1029,7 +1677,7 @@ class Broker:
                 #: the first chunk folded BEFORE the last agent's terminal
                 #: frame — merge cost hid under the slowest agent's compute
                 stats["stream"] = {
-                    "chunks_folded": sum(ctx.folded_chunks.values()),
+                    "chunks_folded": folded_total,
                     "first_fold_unix_ns": ctx.first_fold_ns,
                     "last_terminal_unix_ns": ctx.last_terminal_ns,
                     "merge_overlapped": bool(
@@ -1037,6 +1685,11 @@ class Broker:
                         and ctx.last_terminal_ns is not None
                         and ctx.first_fold_ns < ctx.last_terminal_ns),
                 }
+                #: fault-recovery observability per query: re-dispatch
+                #: rounds paid, agents evicted mid-query, hedged duplicate
+                #: dispatches, and chunks discarded at merge — all zero on
+                #: the fault-free path
+                stats["fault"] = fault
                 if sink_map is not None:
                     stats["sink_map"] = sink_map
                     stats["merger"]["operators"] = ex.op_stats
@@ -1046,8 +1699,8 @@ class Broker:
         finally:
             # span hygiene: a timeout / disconnect / error leaves dispatch
             # spans without an exec_done to close them
-            for agent_name in list(ctx.dispatch_spans):
-                self._finish_dispatch_span(ctx, agent_name,
+            for src in list(ctx.dispatch_spans):
+                self._finish_dispatch_span(ctx, src,
                                            error=ctx.error or "unresolved")
             with self._qlock:
                 self._queries.pop(req_id, None)

@@ -1,0 +1,263 @@
+"""Chaos-recovery harness (the `chaos_recovery` bench config).
+
+Replays a fixed query set against a REAL broker + agent deployment twice:
+once fault-free (the baseline: canonical result bytes + latencies), then
+under an injected kill-and-restart schedule — every `kill_every` queries an
+agent's socket is RST mid-flight and the same agent (same name, same store)
+restarts after `restart_delay_s`.  The whole fault-tolerance stack is in the
+loop: broker-side eviction → re-plan → re-dispatch under fresh tokens
+(`PL_QUERY_RETRIES`), straggler hedging, registry incarnation fencing of the
+dead socket, and client-side auto-retry/reconnect (`PL_CLIENT_RETRIES`).
+
+Acceptance (held absolutely by `bench.py --check-regressions`):
+
+  * recovery_rate == 1.0 — every retryable query returns an answer; zero
+    client-visible errors.
+  * bit_equal_frac == 1.0 — each answer is BIT-equal to the fault-free
+    baseline (canonical row order; float bit patterns compared, not
+    approximations).  Kill-and-restart preserves each agent's store, and
+    per-source folds merge in deterministic sorted-source order, so
+    recovery must not perturb a single bit.
+  * added_p99_ms bounded — recovery costs bounded latency (backoff +
+    re-execution), never an unbounded stall.
+
+Everything is measured from the run — no modeled numbers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+#: the replayed query mix — retryable (non-mutation) shapes only: a partial
+#: agg channel, a multi-key agg with float state (mean/p50 exercise float
+#: fold determinism), and a rows channel with a filter
+SCRIPTS = [
+    """
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mx=('latency', px.max))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df.groupby(['service', 'status']).agg(
+    cnt=('latency', px.count), m=('latency', px.mean),
+    p50=('latency', px.p50))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 500]
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               s=('latency', px.sum))
+px.display(df, 'out')
+""",
+]
+
+
+def _mkstore(seed: int, rows: int):
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    rng = np.random.default_rng(seed)
+    ts = TableStore()
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING),
+        ("latency", DT.FLOAT64), ("status", DT.INT64),
+    )
+    t = ts.create("http_events", rel, batch_rows=1 << 13, max_bytes=1 << 32)
+    svc = np.array([f"svc-{i}" for i in range(6)])
+    t.write({
+        "time_": np.arange(rows, dtype=np.int64) * 1000,
+        "service": svc[rng.integers(0, len(svc), rows)],
+        "latency": rng.exponential(20.0, rows),
+        "status": rng.choice([200, 404, 500], rows, p=[0.9, 0.05, 0.05]),
+    })
+    return ts
+
+
+def canonical_bytes(results: dict) -> bytes:
+    """Order-independent BIT-exact fingerprint of a query answer: per table,
+    rows sort lexicographically by every column's VALUE (dictionary codes
+    decoded — code spaces differ across merges by construction) and the
+    sorted columns' raw bytes concatenate.  Float columns contribute their
+    bit patterns: a recovered query that differs in one ulp fails."""
+    out = []
+    for name in sorted(results):
+        qr = results[name]
+        cols = {}
+        for cname in sorted(qr.columns):
+            arr = qr.columns[cname]
+            if cname in qr.dictionaries:
+                vals = qr.dictionaries[cname].decode(arr)
+                cols[cname] = np.asarray(
+                    [v if v is not None else "" for v in vals], dtype=object)
+            else:
+                cols[cname] = np.asarray(arr)
+        if cols:
+            order = np.lexsort([cols[c] if cols[c].dtype != object
+                                else np.asarray(cols[c], dtype="U64")
+                                for c in sorted(cols)])
+        for cname in sorted(cols):
+            arr = cols[cname][order] if cols else cols[cname]
+            out.append(cname.encode())
+            if arr.dtype == object:
+                out.append("\x00".join(str(v) for v in arr).encode())
+            else:
+                out.append(arr.tobytes())  # bit patterns, not repr
+    return b"\x01".join(out)
+
+
+def _pct(xs: list, q: float) -> float:
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run_chaos(queries: int = 80, rows: int = 200_000, n_agents: int = 3,
+              kill_every: int = 7, restart_delay_s: float = 0.35,
+              retries: int = 6, client_retries: int = 6,
+              backoff_ms: int = 120) -> dict:
+    """Drive the kill-and-restart replay; returns the chaos_recovery dict."""
+    from pixie_tpu import flags, metrics
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client
+
+    saved = {name: flags.get(name) for name in (
+        "PL_QUERY_RETRIES", "PL_RETRY_BACKOFF_MS", "PL_CLIENT_RETRIES")}
+    flags.set_for_testing("PL_QUERY_RETRIES", retries)
+    flags.set_for_testing("PL_RETRY_BACKOFF_MS", backoff_ms)
+    flags.set_for_testing("PL_CLIENT_RETRIES", client_retries)
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0).start()
+    stores = {f"pem{i}": _mkstore(i + 1, rows) for i in range(n_agents)}
+    agents = {n: Agent(n, "127.0.0.1", broker.port, store=st,
+                       heartbeat_s=0.5).start() for n, st in stores.items()}
+    client = Client("127.0.0.1", broker.port, timeout_s=90.0)
+
+    def counters():
+        return {
+            "retries": metrics.counter_value("px_query_retries_total"),
+            "evictions": metrics.counter_value("px_agent_evictions_total"),
+            "hedged": metrics.counter_value("px_hedged_dispatches_total"),
+            "discarded": metrics.counter_value("px_chunks_discarded_total"),
+            "client_retries": metrics.counter_value(
+                "px_client_retries_total"),
+        }
+
+    restarters: list[threading.Thread] = []
+
+    def kill_and_restart(victim: str):
+        """RST the victim's broker socket mid-flight (process-crash analog),
+        then bring the SAME agent (name + store) back after the delay —
+        the k8s pod restart the reference's churn assumptions model."""
+        old = agents[victim]
+        old.conn.abort()
+        old.stop()
+
+        def restart():
+            time.sleep(restart_delay_s)
+            agents[victim] = Agent(victim, "127.0.0.1", broker.port,
+                                   store=stores[victim],
+                                   heartbeat_s=0.5).start()
+
+        th = threading.Thread(target=restart, daemon=True)
+        th.start()
+        restarters.append(th)
+
+    try:
+        # ---- fault-free baseline: canonical bytes + latencies ------------
+        baseline: list[bytes] = []
+        base_lat: list[float] = []
+        for i in range(queries):
+            t0 = time.perf_counter()
+            res = client.execute_script(SCRIPTS[i % len(SCRIPTS)])
+            base_lat.append(time.perf_counter() - t0)
+            baseline.append(canonical_bytes(res))
+        c0 = counters()
+
+        # ---- chaos replay under the kill-and-restart schedule ------------
+        chaos_lat: list[float] = []
+        ok = 0
+        bit_equal = 0
+        errors = 0
+        kills = 0
+        victims = sorted(stores)
+        for i in range(queries):
+            if kill_every > 0 and i % kill_every == kill_every - 1:
+                # the kill lands while query i is in flight: issue it on a
+                # short fuse so some kills hit mid-stream, some mid-dispatch
+                victim = victims[kills % len(victims)]
+                kills += 1
+                threading.Timer(0.01, kill_and_restart, (victim,)).start()
+            t0 = time.perf_counter()
+            try:
+                res = client.execute_script(SCRIPTS[i % len(SCRIPTS)])
+                chaos_lat.append(time.perf_counter() - t0)
+                ok += 1
+                if canonical_bytes(res) == baseline[i]:
+                    bit_equal += 1
+            except Exception:
+                errors += 1
+        for th in restarters:
+            th.join(timeout=10.0)
+        c1 = counters()
+    finally:
+        client.close()
+        for a in agents.values():
+            try:
+                a.stop()
+            except Exception:
+                pass
+        broker.stop()
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+
+    base_p99 = _pct(base_lat, 0.99) * 1000
+    chaos_p99 = _pct(chaos_lat, 0.99) * 1000
+    return {
+        # `rows` = replayed query count: the SHAPE key --check-regressions
+        # matches on, so a --smoke run never diffs against a full run
+        "rows": queries,
+        "queries": queries,
+        "n_agents": n_agents,
+        "kills": kills,
+        "recovery_rate": round(ok / max(queries, 1), 4),
+        "bit_equal_frac": round(bit_equal / max(queries, 1), 4),
+        "client_errors": errors,
+        "baseline_p99_ms": round(base_p99, 1),
+        "chaos_p99_ms": round(chaos_p99, 1),
+        "added_p99_ms": round(max(chaos_p99 - base_p99, 0.0), 1),
+        "baseline_p50_ms": round(_pct(base_lat, 0.5) * 1000, 1),
+        "chaos_p50_ms": round(_pct(chaos_lat, 0.5) * 1000, 1),
+        "broker_retries": round(c1["retries"] - c0["retries"], 1),
+        "evictions": round(c1["evictions"] - c0["evictions"], 1),
+        "hedged": round(c1["hedged"] - c0["hedged"], 1),
+        "chunks_discarded": round(c1["discarded"] - c0["discarded"], 1),
+        "client_retries": round(c1["client_retries"] - c0["client_retries"],
+                                1),
+    }
+
+
+def main(argv=None):  # pragma: no cover — exercised via bench.py
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--queries", type=int, default=80)
+    ap.add_argument("--rows", type=int, default=200_000)
+    ap.add_argument("--agents", type=int, default=3)
+    ap.add_argument("--kill-every", type=int, default=7)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_chaos(queries=args.queries, rows=args.rows,
+                               n_agents=args.agents,
+                               kill_every=args.kill_every),
+                     separators=(",", ":")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -24,6 +24,18 @@ class AgentRecord:
     n_devices: Optional[int]
     last_heartbeat: float
     alive: bool = True
+    #: incarnation fence: bumped on EVERY register.  A restarted agent
+    #: re-registering under the same name supersedes its old socket; frames
+    #: still in flight from the dead incarnation (chunks, acks, heartbeats)
+    #: carry — via their connection's recorded incarnation — a stale value
+    #: and are rejected instead of folded (reference: ASIDs are never
+    #: reused, agent.go expired agents handshake anew).
+    incarnation: int = 0
+    #: monotonic time the agent was last observed dying (disconnect or
+    #: heartbeat expiry); 0 = never died (or recalled-from-KV cold record).
+    #: The broker's rejoin grace window measures from this: a JUST-dead
+    #: agent is likely a restarting pod, not a removed one.
+    died_at: float = 0.0
 
 
 class AgentRegistry:
@@ -73,6 +85,7 @@ class AgentRegistry:
                 rec.n_devices = n_devices
                 rec.last_heartbeat = now
                 rec.alive = True
+            rec.incarnation += 1
             self.kv.set_json(
                 f"agent/{name}",
                 {
@@ -103,6 +116,7 @@ class AgentRegistry:
             if rec is not None:
                 if rec.alive:
                     self.epoch += 1
+                    rec.died_at = time.monotonic()
                 rec.alive = False
 
     def expire(self) -> list[str]:
@@ -113,12 +127,31 @@ class AgentRegistry:
             for rec in self._agents.values():
                 if rec.alive and now - rec.last_heartbeat > self.expiry_s:
                     rec.alive = False
+                    rec.died_at = now
                     out.append(rec.name)
             if out:
                 self.epoch += 1
         return out
 
     # ------------------------------------------------------------------- views
+    def incarnation(self, name: str) -> int:
+        """Current incarnation of `name` (0 = never registered).  Frames
+        from a connection recorded under an older incarnation are stale."""
+        with self._lock:
+            rec = self._agents.get(name)
+            return rec.incarnation if rec is not None else 0
+
+    def recently_dead(self, grace_s: float) -> list[str]:
+        """Agents observed dying within the last `grace_s` seconds — the
+        set the broker's dispatch holds for (a restarting pod re-registers
+        within the grace; a removed one ages out of it)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                rec.name for rec in self._agents.values()
+                if not rec.alive and rec.died_at > 0
+                and now - rec.died_at < grace_s)
+
     def all_agents(self) -> list[AgentRecord]:
         """Every known agent, dead or alive (GetAgentStatus shows both)."""
         self.expire()

@@ -12,8 +12,10 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Optional
 
+from pixie_tpu.services import faultinject
 from pixie_tpu.status import Internal
 
 _LEN = struct.Struct("<I")
@@ -63,6 +65,11 @@ class Connection:
                  name: str = "?"):
         self.sock = sock
         self.name = name
+        #: fault-injection target key (services/faultinject.py): endpoints
+        #: that want to be addressable by a chaos plan set a logical label
+        #: (agents: "agent:<name>", clients: "client"); defaults to the
+        #: peer-addr name so unlabeled conns still match wildcard rules
+        self.label = name
         self._on_frame = on_frame
         self._on_close = on_close
         self._wlock = threading.Lock()
@@ -76,12 +83,49 @@ class Connection:
     def start(self):
         self._thread.start()
 
+    def _apply_fault(self, direction: str) -> str:
+        """Consult the installed fault injector (if any) for one frame.
+        Returns "proceed", "drop" (swallow the frame), or "closed" (the
+        injector killed this connection)."""
+        inj = faultinject.active()
+        if inj is None:
+            return "proceed"
+        d = inj.on_frame(id(self), self.label, direction)
+        if d is None:
+            return "proceed"
+        if d.action == "delay":
+            time.sleep(d.delay_s)
+            return "proceed"
+        if d.action == "drop":
+            return "drop"
+        if d.action == "reset":
+            self.abort()
+            return "closed"
+        self.close()  # crash: the peer sees a dead socket mid-stream
+        return "closed"
+
+    def abort(self) -> None:
+        """Close with SO_LINGER 0 — the peer gets an RST, not a clean FIN
+        (the injected-fault analog of a kernel reaping a crashed process)."""
+        try:
+            self.sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        self.close()
+
     def _read_loop(self):
         from pixie_tpu import metrics as _metrics
 
         while True:
             frame = recv_frame(self.sock)
             if frame is None:
+                break
+            fate = self._apply_fault("recv")
+            if fate == "drop":
+                continue
+            if fate == "closed":
                 break
             _metrics.counter_inc(
                 "px_transport_frames_received_total",
@@ -101,6 +145,13 @@ class Connection:
     def send(self, frame: bytes) -> bool:
         from pixie_tpu import metrics as _metrics
 
+        fate = self._apply_fault("send")
+        if fate == "drop":
+            # the frame vanishes but the caller sees success — exactly what
+            # a crashed peer's kernel buffer does to an un-acked write
+            return True
+        if fate == "closed":
+            return False
         with self._wlock:
             try:
                 send_frame(self.sock, frame)
@@ -163,6 +214,16 @@ class Server:
             try:
                 sock, addr = self._sock.accept()
             except OSError:
+                return
+            if self._stop.is_set():
+                # a dial that completed in the backlog as stop() ran: close
+                # it instead of servicing it — a STOPPED server answering
+                # (e.g. "no live agents") wedges clients that would
+                # otherwise redial the restarted instance on this port
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 

@@ -188,13 +188,19 @@ def encode_json(meta: dict) -> bytes:
     return _frame("json", meta, [])
 
 
-def encode_error(req_id, error, retry_after_s=None) -> bytes:
+def encode_error(req_id, error, retry_after_s=None, retryable=None) -> bytes:
     """The error envelope, optionally carrying an admission-control
-    retry-after hint (seconds).  Clients surface `retry_after_s` so a shed
-    query backs off instead of hammering a saturated broker."""
+    retry-after hint (seconds) and/or a retryable marker.  Clients surface
+    `retry_after_s` so a shed query backs off instead of hammering a
+    saturated broker; `retryable=True` marks an INFRASTRUCTURE failure of
+    an idempotent (non-mutation) query — agent eviction with the retry
+    budget exhausted, no live agents — that a client may transparently
+    re-issue.  Compile/exec errors never set it: retrying won't fix them."""
     meta = {"msg": "error", "req_id": req_id, "error": str(error)}
     if retry_after_s is not None:
         meta["retry_after_s"] = round(float(retry_after_s), 3)
+    if retryable is not None:
+        meta["retryable"] = bool(retryable)
     return _frame("json", meta, [])
 
 

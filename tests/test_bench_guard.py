@@ -281,7 +281,9 @@ def test_serving_load_harness_crash_fails_guards():
     doc["configs"]["serving_load"] = {"rows": 560,
                                       "error": "RuntimeError: boom"}
     regs = bench.absolute_floors(doc)
-    assert len(regs) == len(bench.ABS_CEILINGS) + 1  # +1 shed_total floor
+    n_serving = len([k for k, *_ in bench.ABS_CEILINGS
+                     if k.startswith("configs.serving_load")])
+    assert len(regs) == n_serving + 1  # +1 shed_total floor
     assert all(r.get("missing") for r in regs)
     assert all(r["key"].startswith("configs.serving_load") for r in regs)
     assert "missing at guarded shape" in bench._format_regression(regs[0])
@@ -289,6 +291,52 @@ def test_serving_load_harness_crash_fails_guards():
     # a smoke-shape crash doesn't (smoke isn't guarded)
     doc["configs"]["serving_load"] = {"rows": 60, "error": "boom"}
     assert bench.absolute_floors(doc) == []
+
+
+def _chaos_doc(rows=80, recovery=1.0, bit_equal=1.0, errors=0,
+               added_p99=900.0, kills=11):
+    doc = _doc()
+    doc["configs"]["chaos_recovery"] = {
+        "rows": rows, "queries": rows, "kills": kills,
+        "recovery_rate": recovery, "bit_equal_frac": bit_equal,
+        "client_errors": errors, "added_p99_ms": added_p99,
+    }
+    return doc
+
+
+def test_chaos_recovery_absolute_guards():
+    """ISSUE-10 acceptance held by CI: under the injected kill-and-restart
+    schedule every retryable query recovers (recovery_rate == 1.0) with
+    BIT-equal results (bit_equal_frac == 1.0), zero client-visible errors,
+    bounded added p99 — and the schedule must actually have killed agents."""
+    assert bench.absolute_floors(_chaos_doc()) == []
+    regs = bench.absolute_floors(_chaos_doc(recovery=0.975))
+    assert [r["key"] for r in regs] == [
+        "configs.chaos_recovery.recovery_rate"]
+    assert "below floor" in bench._format_regression(regs[0])
+    regs = bench.absolute_floors(_chaos_doc(bit_equal=0.99))
+    assert [r["key"] for r in regs] == [
+        "configs.chaos_recovery.bit_equal_frac"]
+    assert bench.absolute_floors(_chaos_doc(errors=1))
+    assert bench.absolute_floors(_chaos_doc(added_p99=9_000.0))
+    assert bench.absolute_floors(_chaos_doc(kills=0))
+    # the guards ride compare_bench (the CI entry point) too
+    assert bench.compare_bench(_chaos_doc(), _chaos_doc(bit_equal=0.5),
+                               threshold=0.15)
+    # smoke shape (16 queries) trips nothing — shape-matched guards only
+    assert bench.absolute_floors(
+        _chaos_doc(rows=16, recovery=0.5, bit_equal=0.0, errors=5,
+                   kills=0)) == []
+
+
+def test_chaos_recovery_harness_crash_fails_guards():
+    """A crashed chaos harness at the guarded shape must TRIP the absolute
+    bounds (missing keys), not silently disable the fault-tolerance CI."""
+    doc = _doc()
+    doc["configs"]["chaos_recovery"] = {"rows": 80, "error": "boom"}
+    regs = bench.absolute_floors(doc)
+    assert regs and all(r.get("missing") for r in regs)
+    assert all(r["key"].startswith("configs.chaos_recovery") for r in regs)
 
 
 def test_budget_json_line_sheds_diagnostics_keeps_headline():

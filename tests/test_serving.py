@@ -36,7 +36,7 @@ SERVING_FLAGS = (
     "PL_TENANT_WEIGHTS", "PL_SERVING_MAX_INFLIGHT",
     "PL_SERVING_QUEUE_DEPTH", "PL_SERVING_QUEUE_TIMEOUT_S",
     "PL_SERVING_SHED_WATERMARK", "PL_SERVING_DEGRADED_WINDOW",
-    "PL_TENANT_ISOLATION", "PL_QUERY_FASTPATH",
+    "PL_TENANT_ISOLATION", "PL_QUERY_FASTPATH", "PL_CLIENT_RETRIES",
 )
 
 
@@ -479,6 +479,9 @@ def net_cluster():
 
 def test_quota_shed_over_network_with_retry_after(net_cluster):
     broker, _agents, client = net_cluster
+    # the raw shed surface is under test: the client's auto-retry would
+    # otherwise honor retry_after_s and mask it (tests/test_fault_tolerance)
+    _set(PL_CLIENT_RETRIES=0)
     # 0.2 qps: the bucket holds ONE burst token, and the first query would
     # have to take 5s for the refill to mask the shed (load-robust)
     _set(PL_TENANT_QPS="0,greedy=0.2")

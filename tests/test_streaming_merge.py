@@ -294,6 +294,10 @@ class _MiscountingAgent(Agent):
 
 
 def test_agent_dying_mid_stream_fails_query_cleanly():
+    # fail-fast contract: with retries DISABLED the legacy behavior holds
+    # bit-identically (transparent recovery is tests/test_fault_tolerance.py)
+    flags.set_for_testing("PL_QUERY_RETRIES", 0)
+    flags.set_for_testing("PL_CLIENT_RETRIES", 0)
     broker = Broker(hb_expiry_s=5.0, query_timeout_s=10.0).start()
     stores = {"pem1": _mkstore(1), "pem2": _mkstore(2)}
     a1 = Agent("pem1", "127.0.0.1", broker.port, store=stores["pem1"],
@@ -316,6 +320,8 @@ def test_agent_dying_mid_stream_fails_query_cleanly():
         res = client.execute_script(AGG_SCRIPT)["out"]
         assert res.to_pandas()["cnt"].sum() == 20_000  # pem1's rows ONLY
     finally:
+        flags.set_for_testing("PL_QUERY_RETRIES", 2)
+        flags.set_for_testing("PL_CLIENT_RETRIES", 3)
         client.close()
         a1.stop()
         a2.stop()
