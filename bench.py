@@ -519,6 +519,25 @@ px.display(df, 'output')
     # (PL_QUERY_FASTPATH); hits>0 proves the fast path actually engaged
     out["plan_cache"] = {"hits": cluster.plan_cache.hits,
                          "misses": cluster.plan_cache.misses}
+    # pre-dispatch plan verification (PX_PLAN_VERIFY, pixie_tpu/check/):
+    # warm queries ride the VERIFIED split cache so the measured overhead
+    # should be ~0; a >1% warm-p50 delta earns an explicit note (ISSUE 11)
+    from pixie_tpu import flags as _flags
+
+    pv_prev = _flags.get("PX_PLAN_VERIFY")
+    _flags.set_for_testing("PX_PLAN_VERIFY", False)
+    try:
+        off_times, _ = _times(lambda: cluster.query(script)["output"], reps)
+    finally:
+        _flags.set_for_testing("PX_PLAN_VERIFY", pv_prev)
+    off_p50 = _p50(off_times)
+    pv_frac = (warm_p50 - off_p50) / max(off_p50, 1e-9)
+    out["plan_verify"] = {"warm_off_p50_ms": round(off_p50 * 1000, 1),
+                          "overhead_frac": round(pv_frac, 4)}
+    if pv_frac > 0.01:
+        out["plan_verify"]["note"] = (
+            "PX_PLAN_VERIFY adds >1% to warm interactive_1m p50 "
+            "(expected ~0: warm splits are signature-cached)")
     return out, wholeplan
 
 

@@ -1,0 +1,30 @@
+"""Static analysis for pixie_tpu — two altitudes, one contract.
+
+Runtime chaos tests (PR 9) prove the engine RECOVERS; nothing proved plans
+were well-formed BEFORE dispatch.  Flare and Tailwind (PAPERS.md) both rest
+on a verified lowering contract between the query plan and the native /
+accelerator substrate; this package is that contract, enforced everywhere:
+
+  * ``check.planverify`` — a typed dataflow pass over compiled Carnot plans
+    the broker and LocalCluster run before every dispatch (PX_PLAN_VERIFY,
+    default on).  Schema/dtype flow op-to-op, shard-axis consistency across
+    shuffle boundaries, partial-agg mergeability (the PR 9 fold-correctness
+    linchpin), matview prefix consistency, and limit/window sanity.
+    Violations raise a structured :class:`PlanVerifyError` naming the op and
+    the invariant.  Verified splits ride the whole-query plan cache, so warm
+    queries pay zero re-verification.
+
+  * ``check.pxlint`` — an AST linter over the repo itself
+    (``python -m pixie_tpu.check.pxlint``): lock discipline via the
+    ``*_locked`` naming convention, env reads outside the flags registry,
+    metric/span hygiene, and host callbacks inside jitted code.  Findings
+    are fixed or explicitly owned via ``# pxlint: disable=<rule> -- reason``
+    — never silently ignored.
+"""
+from __future__ import annotations
+
+from pixie_tpu.check.planverify import (  # noqa: F401
+    PlanVerifyError,
+    verify_distributed,
+    verify_plan,
+)

@@ -1,0 +1,592 @@
+"""pxlint: AST-based repo linter for pixie_tpu's concurrency + hygiene
+contracts (``python -m pixie_tpu.check.pxlint [paths] [--ratchet FILE]``).
+
+The broker/agent layer is ~15 lock-guarded structures maintained by hand;
+metrics, spans, and env-config each have ONE sanctioned surface.  These
+conventions only hold if something checks them — this linter does, in CI
+(tests/test_pxlint.py runs it over the whole package).
+
+Rules:
+
+  lock-discipline   a ``*_locked``-suffixed method/function/field (the
+      repo's "caller must hold the owning lock" naming convention) touched
+      outside a ``with <...lock...>:`` guard — unless the touching function
+      is itself ``*_locked`` (the lock is held by contract up the stack).
+      A module may pin WHICH lock owns a member via a module-level
+      ``_pxlint_locks_ = {"<member>": "<expr suffix>"}`` mapping; the guard
+      expression must then end with that suffix (e.g. ``"view.lock"``).
+  env-read          ``os.environ``/``os.getenv`` of a ``PL_*``/``PX_*``/
+      ``PIXIE_TPU_*`` name anywhere but flags.py — declared, typed flags
+      (``flags.define_*``) are the one config surface; stray reads dodge
+      dump()/introspection and silently fork defaults.
+  metric-hygiene    metric names must be ``px_*`` string literals, and every
+      written series must be REGISTERED (at least one call site passes
+      ``help_=``) so /metrics never exposes undocumented names.
+  span-hygiene      spans open only through the context-manager API
+      (``trace.span``/``root``/``maybe_root`` as a ``with`` item, or the
+      designated manual ``trace.start_child``); raw ``Tracer.start_span``
+      outside trace.py leaks open spans past the hygiene ratchet.
+  jit-host-callback no host callbacks (``print``, ``jax.debug.*``,
+      ``pure_callback``/``io_callback``/``host_callback``) inside functions
+      handed to ``jax.jit``/``shard_map`` — they silently synchronize the
+      device stream (and deadlock under the XLA-CPU collective gate).
+  bad-suppression   a suppression comment without a reason, or naming an
+      unknown rule.
+
+Suppression: ``# pxlint: disable=<rule>[,<rule>] -- <reason>`` on (or one
+line above) the flagged statement.  The reason is REQUIRED: findings are
+fixed or explicitly owned, never silently ignored.
+
+Ratchet: ``--ratchet FILE`` holds grandfathered ``path:rule: N`` counts.
+New findings beyond an entry fail; an entry exceeding reality is STALE and
+also fails (the ratchet only tightens).
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import pathlib
+import re
+import sys
+import tokenize
+from typing import Optional
+
+RULES = frozenset({
+    "lock-discipline", "env-read", "metric-hygiene", "span-hygiene",
+    "jit-host-callback", "bad-suppression",
+})
+
+_ENV_NAME = re.compile(r"^(PL_|PX_|PIXIE_TPU_)")
+_SUPPRESS = re.compile(
+    r"#\s*pxlint:\s*disable=([A-Za-z0-9_,-]+)\s*(?:--\s*(\S.*))?")
+
+#: metrics-module write/read surfaces (name = first arg)
+_METRIC_WRITES = frozenset({"counter_inc", "gauge_set", "histogram_observe",
+                            "register_gauge_fn"})
+_METRIC_READS = frozenset({"counter_value", "counter_series", "has_gauge_fn",
+                           "unregister_gauge_fn"})
+#: positional index of help_ per write fn (fallback when passed positionally)
+_HELP_POS = {"counter_inc": 3, "gauge_set": 3, "histogram_observe": 4,
+             "register_gauge_fn": 2}
+
+_SPAN_CMS = frozenset({"span", "root", "maybe_root"})
+
+_BANNED_IN_JIT = ("print", "jax.debug.print", "jax.debug.callback",
+                  "jax.pure_callback", "pure_callback", "io_callback",
+                  "jax.experimental.io_callback", "host_callback.call",
+                  "host_callback.id_tap")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    msg: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule}: {self.msg}"
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _parents(tree: ast.AST) -> dict:
+    par = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            par[child] = node
+    return par
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _subtree_mentions_lock(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and "lock" in n.id.lower():
+            return True
+        if isinstance(n, ast.Attribute) and "lock" in n.attr.lower():
+            return True
+    return False
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on py>=3.9
+        return "<?>"
+
+
+class _FileCtx:
+    """One parsed file: source, tree, parent links, suppressions."""
+
+    def __init__(self, path: pathlib.Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.src = path.read_text()
+        self.tree = ast.parse(self.src, filename=str(path))
+        self.par = _parents(self.tree)
+        #: line -> set of suppressed rules
+        self.suppress: dict[int, set] = {}
+        self.findings: list[Finding] = []
+        self._scan_comments()
+        #: module-level owning-lock annotation
+        self.lock_owners: dict[str, str] = {}
+        for node in self.tree.body:
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+            elif isinstance(node, ast.AnnAssign):
+                tgt = node.target
+            if isinstance(tgt, ast.Name) and tgt.id == "_pxlint_locks_" \
+                    and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and isinstance(
+                            v, ast.Constant):
+                        self.lock_owners[str(k.value)] = str(v.value)
+
+    def _scan_comments(self) -> None:
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.src).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                line = tok.start[0]
+                unknown = rules - RULES
+                if unknown:
+                    self.findings.append(Finding(
+                        self.rel, line, "bad-suppression",
+                        f"unknown rule(s) {sorted(unknown)}"))
+                    rules &= RULES
+                if not m.group(2):
+                    self.findings.append(Finding(
+                        self.rel, line, "bad-suppression",
+                        "suppression requires a reason: "
+                        "# pxlint: disable=<rule> -- <why this is safe>"))
+                    continue
+                self.suppress.setdefault(line, set()).update(rules)
+        except tokenize.TokenError:  # pragma: no cover
+            pass
+
+    def suppressed(self, node: ast.AST, rule: str) -> bool:
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", lo) or lo
+        for line in range(lo - 1, hi + 1):
+            if rule in self.suppress.get(line, ()):
+                return True
+        return False
+
+    def add(self, node: ast.AST, rule: str, msg: str) -> None:
+        if not self.suppressed(node, rule):
+            self.findings.append(Finding(
+                self.rel, getattr(node, "lineno", 0), rule, msg))
+
+    def ancestors(self, node: ast.AST):
+        cur = self.par.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.par.get(cur)
+
+
+# ----------------------------------------------------------- lock discipline
+
+
+def _check_lock_discipline(ctx: _FileCtx) -> None:
+    for node in ast.walk(ctx.tree):
+        member = None
+        anchor = node
+        if isinstance(node, ast.Call):
+            member = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id if isinstance(node.func, ast.Name)
+                      else None)
+        elif isinstance(node, (ast.Attribute, ast.Name)):
+            # bare loads (field reads / callback references); Call funcs are
+            # handled above — skip the func child to avoid double reports
+            parent = ctx.par.get(node)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            member = node.attr if isinstance(node, ast.Attribute) else node.id
+        if not member or not member.endswith("_locked"):
+            continue
+        guard_expr = None
+        held = False
+        for anc in ctx.ancestors(anchor):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    if _subtree_mentions_lock(item.context_expr):
+                        held = True
+                        guard_expr = item.context_expr
+                        break
+            if held:
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if anc.name.endswith("_locked"):
+                    held = True  # caller holds the lock by contract
+                break  # guards don't cross function boundaries
+        if not held:
+            ctx.add(node, "lock-discipline",
+                    f"{member!r} touched outside a `with <lock>:` guard "
+                    "(callers of *_locked members must hold the owning "
+                    "lock)")
+            continue
+        owner = ctx.lock_owners.get(member)
+        if owner and guard_expr is not None:
+            text = _unparse(guard_expr)
+            if not text.endswith(owner):
+                ctx.add(node, "lock-discipline",
+                        f"{member!r} guarded by {text!r} but its declared "
+                        f"owning lock is {owner!r} (_pxlint_locks_)")
+
+
+# ----------------------------------------------------------------- env read
+
+
+def _env_name_of(node: ast.Call | ast.Subscript | ast.Compare
+                 ) -> Optional[tuple]:
+    """(env var name, how) when `node` reads the process environment."""
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d is None:
+            return None
+        leaf = d.split(".")[-1]
+        if leaf == "getenv" and len(d.split(".")) >= 2:
+            if node.args and isinstance(node.args[0], ast.Constant):
+                return str(node.args[0].value), "os.getenv"
+            return "<dynamic>", "os.getenv"
+        if leaf in ("get", "setdefault") and ".environ." in d + ".":
+            if node.args and isinstance(node.args[0], ast.Constant):
+                return str(node.args[0].value), f"environ.{leaf}"
+            return "<dynamic>", f"environ.{leaf}"
+        return None
+    if isinstance(node, ast.Subscript):
+        d = _dotted(node.value)
+        if d is not None and d.split(".")[-1] == "environ":
+            sl = node.slice
+            if isinstance(sl, ast.Constant):
+                return str(sl.value), "environ[]"
+            return "<dynamic>", "environ[]"
+        return None
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], (ast.In, ast.NotIn)):
+        d = _dotted(node.comparators[0])
+        if d is not None and d.split(".")[-1] == "environ" \
+                and isinstance(node.left, ast.Constant):
+            return str(node.left.value), "in environ"
+    return None
+
+
+def _check_env_read(ctx: _FileCtx) -> None:
+    if ctx.path.name == "flags.py":
+        return  # the one sanctioned surface
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Call, ast.Subscript, ast.Compare)):
+            continue
+        got = _env_name_of(node)
+        if got is None:
+            continue
+        name, how = got
+        if name != "<dynamic>" and not _ENV_NAME.match(name):
+            continue  # PATH/HOME etc. are not engine flags
+        ctx.add(node, "env-read",
+                f"direct {how} of {name!r}: engine config must go through "
+                "flags.define_* / flags.get (flags.py is the only "
+                "sanctioned env surface)")
+
+
+# ------------------------------------------------------------ metric hygiene
+
+
+def _metric_call(node: ast.Call) -> Optional[tuple]:
+    """(fn leaf, name node, registered: bool) for metrics-module calls."""
+    d = _dotted(node.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    leaf = parts[-1]
+    if leaf not in _METRIC_WRITES | _METRIC_READS:
+        return None
+    if len(parts) >= 2 and parts[-2] not in ("metrics", "_metrics"):
+        return None
+    if len(parts) == 1:
+        return None  # local helpers sharing a name
+    name_node = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "name":
+            name_node = kw.value
+    registered = any(kw.arg == "help_" for kw in node.keywords)
+    hp = _HELP_POS.get(leaf)
+    if hp is not None and len(node.args) > hp:
+        registered = True
+    return leaf, name_node, registered
+
+
+def _check_metric_hygiene(ctx: _FileCtx, registry: dict) -> None:
+    """First pass: per-file checks + collect (name -> registered anywhere,
+    first write site) into `registry` for the cross-file pass."""
+    if ctx.path.name == "metrics.py":
+        return  # the registry's own internals
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        got = _metric_call(node)
+        if got is None:
+            continue
+        leaf, name_node, registered = got
+        if not (isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)):
+            ctx.add(node, "metric-hygiene",
+                    f"{leaf}: metric name must be a px_* string literal "
+                    "(dynamic names defeat static registration checks)")
+            continue
+        name = name_node.value
+        if not name.startswith("px_"):
+            ctx.add(node, "metric-hygiene",
+                    f"metric {name!r} must be px_-prefixed")
+        if leaf in _METRIC_WRITES:
+            ent = registry.setdefault(
+                name, {"registered": False, "site": (ctx.rel, node.lineno),
+                       "node": node, "ctx": ctx})
+            ent["registered"] = ent["registered"] or registered
+
+
+def _finish_metric_hygiene(registry: dict) -> list[Finding]:
+    out = []
+    for name, ent in sorted(registry.items()):
+        if not ent["registered"]:
+            ctx, node = ent["ctx"], ent["node"]
+            if not ctx.suppressed(node, "metric-hygiene"):
+                rel, line = ent["site"]
+                out.append(Finding(
+                    rel, line, "metric-hygiene",
+                    f"metric {name!r} is never registered: at least one "
+                    "write site must pass help_= (the /metrics HELP text)"))
+    return out
+
+
+# -------------------------------------------------------------- span hygiene
+
+
+def _check_span_hygiene(ctx: _FileCtx) -> None:
+    if ctx.path.name == "trace.py":
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "start_span":
+            ctx.add(node, "span-hygiene",
+                    "raw Tracer.start_span outside trace.py: open spans "
+                    "via `with trace.span(...)` / trace.root / "
+                    "trace.event_span / trace.start_child so the hygiene "
+                    "ratchet (started == finished) holds")
+            continue
+        d = _dotted(node.func)
+        if d is None:
+            continue
+        parts = d.split(".")
+        if len(parts) != 2 or parts[0] not in ("trace", "_trace") \
+                or parts[1] not in _SPAN_CMS:
+            continue
+        if _span_cm_ok(ctx, node):
+            continue
+        ctx.add(node, "span-hygiene",
+                f"trace.{parts[1]}(...) must be entered as a context "
+                "manager (`with` item, possibly via an assigned variable) "
+                "— a span cm never entered is a silent no-op")
+
+
+def _span_cm_ok(ctx: _FileCtx, node: ast.Call) -> bool:
+    fn = None
+    assigned: Optional[str] = None
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.withitem):
+            return True
+        if isinstance(anc, ast.Assign) and assigned is None:
+            if len(anc.targets) == 1 and isinstance(anc.targets[0], ast.Name):
+                assigned = anc.targets[0].id
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = anc
+            break
+    if assigned is None or fn is None:
+        return False
+    for n in ast.walk(fn):
+        if isinstance(n, ast.With):
+            for item in n.items:
+                if isinstance(item.context_expr, ast.Name) \
+                        and item.context_expr.id == assigned:
+                    return True
+    return False
+
+
+# --------------------------------------------------------- jit host callback
+
+
+def _jitted_functions(ctx: _FileCtx) -> list:
+    """Function bodies (FunctionDef or Lambda) that are traced by
+    jax.jit / shard_map, resolved lexically."""
+    defs: dict[str, list] = {}
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(n.name, []).append(n)
+    out = []
+    for n in ast.walk(ctx.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                d = _dotted(dec.func if isinstance(dec, ast.Call) else dec)
+                if d and d.split(".")[-1] in ("jit", "pjit"):
+                    out.append(n)
+        if not isinstance(n, ast.Call):
+            continue
+        d = _dotted(n.func)
+        if d is None or d.split(".")[-1] not in ("jit", "pjit", "shard_map"):
+            continue
+        if not n.args:
+            continue
+        target = n.args[0]
+        if isinstance(target, ast.Lambda):
+            out.append(target)
+        elif isinstance(target, ast.Name):
+            out.extend(defs.get(target.id, ()))
+    return out
+
+
+def _check_jit_host_callback(ctx: _FileCtx) -> None:
+    for fn in _jitted_functions(ctx):
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Call):
+                continue
+            d = _dotted(n.func)
+            if d is None:
+                continue
+            if d == "print" or any(d == b or d.endswith("." + b)
+                                   for b in _BANNED_IN_JIT if "." in b) \
+                    or d.split(".")[-1] in ("pure_callback", "io_callback"):
+                name = getattr(fn, "name", "<lambda>")
+                ctx.add(n, "jit-host-callback",
+                        f"host callback {d!r} inside jitted/shard_mapped "
+                        f"function {name!r}: host calls inside a traced "
+                        "program synchronize the device stream (and can "
+                        "deadlock the XLA-CPU collective gate)")
+
+
+# --------------------------------------------------------------------- main
+
+
+#: package root (default lint scope)
+_PKG = pathlib.Path(__file__).resolve().parent.parent
+_REPO = _PKG.parent
+
+
+def _iter_files(paths: list[pathlib.Path]):
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_paths(paths: Optional[list] = None) -> list[Finding]:
+    """Run every rule over `paths` (default: the pixie_tpu package).
+    Returns unsuppressed findings sorted by (path, line)."""
+    roots = [pathlib.Path(p) for p in paths] if paths else [_PKG]
+    metric_registry: dict = {}
+    findings: list[Finding] = []
+    for f in _iter_files(roots):
+        try:
+            rel = str(f.resolve().relative_to(_REPO))
+        except ValueError:
+            rel = str(f)
+        try:
+            ctx = _FileCtx(f, rel)
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 0, "bad-suppression",
+                                    f"file does not parse: {e.msg}"))
+            continue
+        _check_lock_discipline(ctx)
+        _check_env_read(ctx)
+        _check_metric_hygiene(ctx, metric_registry)
+        _check_span_hygiene(ctx)
+        _check_jit_host_callback(ctx)
+        findings.extend(ctx.findings)
+    findings.extend(_finish_metric_hygiene(metric_registry))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def load_ratchet(path) -> dict[tuple, int]:
+    """{(path, rule): allowed count} from a ratchet file."""
+    out: dict[tuple, int] = {}
+    for raw in pathlib.Path(path).read_text().splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line:
+            continue
+        m = re.match(r"^(.*?):([A-Za-z0-9-]+):\s*(\d+)$", line)
+        if not m:
+            raise ValueError(f"bad ratchet line: {raw!r}")
+        out[(m.group(1), m.group(2))] = int(m.group(3))
+    return out
+
+
+def apply_ratchet(findings: list[Finding], allowed: dict[tuple, int]
+                  ) -> tuple[list[Finding], list[str]]:
+    """(net findings beyond the ratchet, stale-entry complaints)."""
+    counts: dict[tuple, int] = {}
+    for f in findings:
+        counts[(f.path, f.rule)] = counts.get((f.path, f.rule), 0) + 1
+    net = [f for f in findings
+           if counts.get((f.path, f.rule), 0) > allowed.get(
+               (f.path, f.rule), 0)]
+    stale = [
+        f"{p}:{r}: ratchet allows {n} but only {counts.get((p, r), 0)} "
+        "remain — tighten the ratchet file"
+        for (p, r), n in sorted(allowed.items())
+        if counts.get((p, r), 0) < n
+    ]
+    return net, stale
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pixie_tpu.check.pxlint",
+        description="repo-wide concurrency & invariant lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the pixie_tpu "
+                         "package)")
+    ap.add_argument("--ratchet", default=None,
+                    help="grandfathered-findings file (path:rule: N lines)")
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.paths or None)
+    stale: list[str] = []
+    if args.ratchet:
+        findings, stale = apply_ratchet(findings, load_ratchet(args.ratchet))
+    for f in findings:
+        print(f)
+    for s in stale:
+        print(s)
+    n = len(findings) + len(stale)
+    if n:
+        print(f"pxlint: {n} problem(s)")
+        return 1
+    print("pxlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,6 +67,11 @@ flags.define_float(
 # crash at import time the day the defaults diverge
 import pixie_tpu.engine.plancache  # noqa: E402,F401 — defines PL_TENANT_ISOLATION
 
+#: pxlint lock-discipline: the refresh path is owned by the per-VIEW lock
+#: (StandingView.lock), NOT the manager's _lock — the manager lock only
+#: guards the _views dict (checked by pixie_tpu.check.pxlint)
+_pxlint_locks_ = {"_refresh_locked": "view.lock"}
+
 #: live managers, for the process-wide state gauges
 _MANAGERS: "weakref.WeakSet" = weakref.WeakSet()
 _GAUGES_ONCE = threading.Lock()

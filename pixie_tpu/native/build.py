@@ -8,10 +8,40 @@ fallback, so a missing toolchain degrades performance, never correctness
 from __future__ import annotations
 
 import ctypes
-import os
 import pathlib
 import subprocess
 import threading
+
+from pixie_tpu import flags as _flags
+
+_flags.define_str(
+    "PIXIE_TPU_NO_NATIVE", "",
+    "force the pure-Python fallbacks even when the g++ toolchain is "
+    "available (perf A/B and toolchain-bug escape hatch).  A kill switch: "
+    "ANY value except ''/0/false/no/off disables native.  Live: read at "
+    "first load_native() use, not import", live=True)
+
+
+def _no_native() -> bool:
+    # historic semantics preserved: any non-empty value disables native
+    # unless it is an explicit falsy spelling — a kill switch must not
+    # fail silently on a non-canonical truthy value
+    val = str(_flags.get("PIXIE_TPU_NO_NATIVE")).strip().lower()
+    return bool(val) and val not in ("0", "false", "no", "off")
+
+_flags.define_str(
+    "PX_NATIVE_SANITIZE", "",
+    "sanitizer build mode for the native STANDALONE test harnesses "
+    "(tests/test_native_sanitize.py): 'address' = ASan+UBSan, 'thread' = "
+    "TSan over the concurrent pthread driver (the slow lane).  Sanitizers "
+    "never apply to the ctypes .so — they need an instrumented host binary",
+    live=True)
+
+#: g++ flags per sanitizer mode (the harness tests compile with these)
+SANITIZER_ARGS = {
+    "address": ["-fsanitize=address,undefined", "-fno-omit-frame-pointer"],
+    "thread": ["-fsanitize=thread", "-fno-omit-frame-pointer"],
+}
 
 _REPO = pathlib.Path(__file__).resolve().parent.parent.parent
 _SRC_DIR = _REPO / "native"
@@ -51,7 +81,7 @@ def load_native():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("PIXIE_TPU_NO_NATIVE"):
+        if _no_native():
             return None
         if not _build():
             return None

@@ -282,8 +282,17 @@ class LocalCluster:
                                      registry=self.registry))
         if q.mutations:
             self.apply_mutations(q.mutations)
-        (dp, _extras), _shit = _QPC.get_split(
-            entry, fp, lambda: (self.planner.plan(q.plan), {}))
+
+        def _split():
+            dp = self.planner.plan(q.plan)
+            # verification rides the fresh split: a split-cache hit IS a
+            # verified split, so warm queries pay zero re-verification
+            from pixie_tpu.check import planverify
+
+            planverify.maybe_verify(dp, self.schemas(), self.registry)
+            return dp, {}
+
+        (dp, _extras), _shit = _QPC.get_split(entry, fp, _split)
         return self.execute(q.plan, analyze=analyze, dp=dp,
                             tenant=tenant or "")
 
@@ -306,6 +315,11 @@ class LocalCluster:
                 dp=None, tenant: str = "") -> dict[str, QueryResult]:
         if dp is None:
             dp = self.planner.plan(logical)
+            # direct-plan callers (no plan cache in front) verify here;
+            # query() verifies inside its split-cache fill instead
+            from pixie_tpu.check import planverify
+
+            planverify.maybe_verify(dp, self.schemas(), self.registry)
 
         # 1. run agent fragments (reference: per-agent Carnot::ExecutePlan),
         #    each SPMD over the agent's device mesh (AgentInfo.n_devices).

@@ -426,9 +426,15 @@ def run_multihost(rows: int, repeats: int, mesh) -> dict:
 
 # ---------------------------------------------------- subprocess harness
 def _worker_env(devices_per_proc: int) -> dict:
+    from pixie_tpu import flags as _flags
+
     repo = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     return {
+        # PL_*/PX_* engine config crosses the fork through the flag
+        # registry, not ad-hoc os.environ reads: whatever this process
+        # overrode (env or set_for_testing) re-parses in the worker
+        **_flags.env_exports(),
         "PATH": os.environ.get("PATH", "/usr/bin:/bin:/usr/local/bin"),
         "HOME": os.environ.get("HOME", "/tmp"),
         "JAX_PLATFORMS": "cpu",

@@ -52,6 +52,12 @@ _SERIALIZE_FLAG = _flags.define_int(
     "the host intra-op pool), 0 = never (trust the runtime's rendezvous), "
     "1 = always (debugging aid)")
 
+_flags.define_str(
+    "PIXIE_TPU_SPMD", "auto",
+    "default-mesh gate: 0 disables SPMD over local devices (single-device "
+    "execution); anything else auto-builds the pow2-clamped mesh.  Live: "
+    "read at first default_mesh() use, not import", live=True)
+
 _gate_lock = _threading.Lock()
 _gate_cache: dict | None = None
 
@@ -142,8 +148,6 @@ def default_mesh() -> Mesh | None:
     Thread-safe: concurrent agent executors race this on first use."""
     global _DEFAULT_MESH, _DEFAULT_MESH_READY
     if not _DEFAULT_MESH_READY:
-        import os
-
         with _DEFAULT_MESH_LOCK:
             if not _DEFAULT_MESH_READY:
                 n = len(jax.devices())
@@ -151,7 +155,7 @@ def default_mesh() -> Mesh | None:
                 # 6-device mesh would fail every `bucket % n_dev == 0` gate
                 # and silently disable SPMD; a 4-device mesh actually runs.
                 n = 1 << (n.bit_length() - 1)
-                if os.environ.get("PIXIE_TPU_SPMD", "auto") != "0" and n > 1:
+                if _flags.get("PIXIE_TPU_SPMD") != "0" and n > 1:
                     _DEFAULT_MESH = make_mesh(n)
                 # publish the mesh BEFORE the ready flag (lock-free readers)
                 _DEFAULT_MESH_READY = True

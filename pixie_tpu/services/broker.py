@@ -90,6 +90,10 @@ HEDGE_MIN_SAMPLES = 8
 #: a retry-budget-exhausted error
 MAX_BACKOFF_MS = 5000.0
 
+#: pxlint lock-discipline: _QueryCtx's *_locked members are owned by the
+#: per-query ctx lock (checked by pixie_tpu.check.pxlint at CI time)
+_pxlint_locks_ = {"_check_done_locked": ".lock"}
+
 
 class _QueryCtx:
     """In-flight bookkeeping for one distributed query (or tracepoint
@@ -227,8 +231,7 @@ class _QueryCtx:
             covered.update(i["agent"] for i in self.pending.values())
             return sorted(self.needed_agents - covered)
 
-    def _check_done(self) -> None:
-        # callers hold self.lock
+    def _check_done_locked(self) -> None:
         if self.error is not None or self.needed_agents <= set(self.accepted):
             self.done.set()
         self.wake.set()
@@ -237,7 +240,7 @@ class _QueryCtx:
         with self.lock:
             if self.error is None:
                 self.error = error
-            self._check_done()
+            self._check_done_locked()
 
     # --------------------------------------- producer frames (reader threads)
     def on_exec_done(self, meta: dict):
@@ -255,13 +258,13 @@ class _QueryCtx:
             if agent in self.accepted:
                 # a hedge raced: first answer already won — this src's
                 # chunks are discarded at merge (never accepted)
-                self._check_done()
+                self._check_done_locked()
                 return None
             self.accepted[agent] = src
             self.agent_stats[agent] = meta.get("stats", {})
             for cid, n in (meta.get("chunks") or {}).items():
                 self.expected_chunks[(cid, src)] = int(n)
-            self._check_done()
+            self._check_done_locked()
             return agent, _time.monotonic() - info["t0"]
 
     def on_exec_error(self, meta: dict) -> Optional[str]:
@@ -281,7 +284,7 @@ class _QueryCtx:
             err = f"agent {meta.get('agent')}: {meta.get('error')}"
             if self.error is None:
                 self.error = err
-            self._check_done()
+            self._check_done_locked()
             return err
 
     def on_agent_lost(self, agent: str, reason: str) -> list[str]:
@@ -302,7 +305,7 @@ class _QueryCtx:
             if not self.retryable:
                 if self.error is None:
                     self.error = f"agent {agent} disconnected mid-query"
-                self._check_done()
+                self._check_done_locked()
                 return srcs
             self.evictions.append((agent, reason))
             self.wake.set()
@@ -1174,6 +1177,11 @@ class Broker:
         def _split():
             with trace.span("plan_split", redispatch=True):
                 dp2 = DistributedPlanner(spec).plan(q.plan)
+                # the re-planned split dispatches too: same pre-dispatch
+                # verification contract as the first round
+                from pixie_tpu.check import planverify
+
+                planverify.maybe_verify(dp2, spec.combined_schemas(), reg)
                 extras = {"plan_json": {
                     a: _json.dumps(p.to_dict())
                     for a, p in dp2.agent_plans.items()
@@ -1208,7 +1216,7 @@ class Broker:
                             != extras2["plan_json"][agent]):
                         ctx.pending.pop(src, None)
                 ctx.hedged_agents.clear()  # fresh round, fresh hedge budget
-                ctx._check_done()
+                ctx._check_done_locked()
         for agent in ctx.uncovered_agents():
             try:
                 self._send_execute(ctx, req_id, agent,
@@ -1431,6 +1439,13 @@ class Broker:
         def _split():
             with trace.span("plan_split"):
                 dp = DistributedPlanner(spec).plan(q.plan)
+                # pre-dispatch verification rides the split computation, so
+                # a cached split IS a verified split: warm queries skip it
+                # entirely (check/planverify.py, PX_PLAN_VERIFY)
+                from pixie_tpu.check import planverify
+
+                planverify.maybe_verify(dp, spec.combined_schemas(),
+                                        self.udf_registry)
                 # pre-serialize the per-agent plan dicts: the dispatch loop
                 # splices these cached JSON fragments into each execute
                 # frame instead of re-walking + re-dumping the plan per query

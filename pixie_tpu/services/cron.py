@@ -62,8 +62,11 @@ class Ticker:
                     self.error_count += 1
                     from pixie_tpu import metrics as _metrics
 
-                    _metrics.counter_inc("px_ticker_errors_total",
-                                         labels={"ticker": self.name})
+                    _metrics.counter_inc(
+                        "px_ticker_errors_total",
+                        labels={"ticker": self.name},
+                        help_="background ticker callbacks that raised "
+                              "(the loop continues)")
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name=f"pixie-ticker-{self.name}")
@@ -159,8 +162,11 @@ class CronScriptRunner:
                 err = str(e)
                 from pixie_tpu import metrics as _metrics
 
-                _metrics.counter_inc("px_cron_script_errors_total",
-                                     labels={"script": cs.name})
+                _metrics.counter_inc(
+                    "px_cron_script_errors_total",
+                    labels={"script": cs.name},
+                    help_="cron script executions that raised (recorded on "
+                          "the script's error_count/last_error)")
             # Record outcome on whatever object is CURRENTLY registered under
             # this name — an upsert mid-run replaces the object and would
             # otherwise lose the counters.

@@ -34,15 +34,21 @@ Kinds:
 from __future__ import annotations
 
 import json
-import os
 import struct
 import zlib
 
 import numpy as np
 
+from pixie_tpu import flags as _flags
 from pixie_tpu.status import InvalidArgument
 from pixie_tpu.table.dictionary import Dictionary
 from pixie_tpu.types import STORAGE_DTYPE, DataType as DT
+
+_flags.define_str(
+    "PL_WIRE_COMPRESS", "",
+    "wire payload compaction: zlib[:<threshold>] | lz4[:<threshold>] | "
+    "off.  Live: re-read per frame so tests/operators can toggle "
+    "per-process", live=True)
 
 MAGIC = b"PXW1"
 _HDR = struct.Struct("<4sI")
@@ -73,10 +79,10 @@ def _norm_dtype(d: np.dtype) -> str:
 def _compress_cfg() -> tuple[str, int] | None:
     """(codec, threshold) from PL_WIRE_COMPRESS, or None when disabled.
 
-    Read from the environment on every frame (not latched at import): tests
+    A LIVE flag: re-read on every frame (not latched at import) — tests
     and operators toggle it per-process, and the parse is nanoseconds.
     """
-    raw = os.environ.get("PL_WIRE_COMPRESS", "").strip().lower()
+    raw = str(_flags.get("PL_WIRE_COMPRESS")).strip().lower()
     if not raw or raw in ("0", "off", "false", "no"):
         return None
     codec, _, thr = raw.partition(":")

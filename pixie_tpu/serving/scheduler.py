@@ -45,6 +45,16 @@ from pixie_tpu.serving.admission import (
 #: half the rounds a weight-1 tenant does
 QUANTUM = 1.0
 
+#: pxlint lock-discipline: every *_locked member of ServingFront is owned
+#: by the front's one mutex (checked by pixie_tpu.check.pxlint)
+_pxlint_locks_ = {
+    "_retry_hint_locked": "self._lock",
+    "_shed_locked": "self._lock",
+    "_run_locked": "self._lock",
+    "_eligible_locked": "self._lock",
+    "_dispatch_locked": "self._lock",
+}
+
 
 def enabled() -> bool:
     return bool(flags.get("PL_SERVING_ENABLED"))
@@ -212,8 +222,15 @@ class ServingFront:
                         self.total_queued -= 1
                     except ValueError:
                         pass  # a dispatch raced the timeout; honor it below
-            if t.outcome is None:
-                self._shed(t, "timeout", self._retry_hint_locked(cap))
+                    else:
+                        # shed under the SAME lock hold that dequeued: the
+                        # retry hint reads total_queued, and deciding
+                        # outside the lock let a racing dispatch's "run"
+                        # outcome be overwritten with "shed" (leaking its
+                        # inflight slot)
+                        self._shed_locked(t, "timeout",
+                                          self._retry_hint_locked(cap),
+                                          raise_=False)
             t.event.wait()  # raced dispatch: the outcome is set by now
         t.wait_ns = time.time_ns() - t.enqueue_ns
         if t.outcome == "shed":
@@ -245,10 +262,6 @@ class ServingFront:
         # crude drain-time estimate: queued work over capacity, floored at
         # 0.5s so clients don't hammer a saturated broker
         return min(30.0, 0.5 + self.total_queued / max(1, cap))
-
-    def _shed(self, t: Ticket, reason: str, retry_after: float) -> None:
-        with self._lock:
-            self._shed_locked(t, reason, retry_after, raise_=False)
 
     def _shed_locked(self, t: Ticket, reason: str, retry_after: float,
                      raise_: bool = True):

@@ -39,6 +39,15 @@ DEFAULT_TABLE_BYTES = 256 * 1024 * 1024
 #: reused by a new allocation, which would alias cache keys.
 _table_uid = itertools.count(1)
 
+#: pxlint lock-discipline: Table's *_locked members are owned by the
+#: per-table mutex (checked by pixie_tpu.check.pxlint)
+_pxlint_locks_ = {
+    "_seal_full_locked": "self._lock",
+    "_expire_locked": "self._lock",
+    "_take_hot_locked": "self._lock",
+    "_hot_bytes_locked": "self._lock",
+}
+
 
 class _SealedBatch:
     __slots__ = ("batch", "row_id_start", "min_time", "max_time", "nbytes", "gen")

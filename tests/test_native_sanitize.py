@@ -1,45 +1,94 @@
-"""Sanitizer run over the native hot loops (A2 — the analog of the
-reference's bazel --config asan/ubsan CI runs, .bazelrc:102-136).
+"""Sanitizer runs over the native hot loops (A2 — the analog of the
+reference's bazel --config asan/ubsan/tsan CI runs, .bazelrc:102-136).
 
-Compiles native/dictionary.cc + stream_agg.cc together with a standalone
-harness under -fsanitize=address,undefined and executes it: heap overflows,
-UB, and leaks in the C++ ingest/poll hot paths fail this test.  (A TSAN
-build needs an instrumented interpreter for the ctypes path, so the
-threaded section runs under ASAN instead, which still catches cross-thread
-heap misuse.)
+Two harnesses, both standalone binaries (sanitizers cannot ride along
+inside the ctypes .so loaded by a non-instrumented Python):
+
+  * sanitize_main.cc — dictionary + stream_agg correctness under
+    ASan+UBSan; tier-1 (the smoke lane).
+  * concurrent_main.cc — the PTHREAD paths (wholeplan batch-range pool,
+    radix join's internal thread pools, the dictionary's parallel probe
+    phase) hammered from real concurrency shapes.  Tier-1 smokes it under
+    ASan in quick mode; the TSan build (`PX_NATIVE_SANITIZE=thread`, the
+    native/build.SANITIZER_ARGS table) runs full-size in the slow lane.
 """
+import os
 import pathlib
 import subprocess
 
 import pytest
 
+from pixie_tpu.native.build import SANITIZER_ARGS
+
 NATIVE = pathlib.Path(__file__).resolve().parents[1] / "native"
+
+
+def _build(tmp_path_factory, name: str, srcs: list, mode: str) -> str:
+    out = tmp_path_factory.mktemp("san") / name
+    cmd = ["g++", "-std=c++17", "-g", "-O1", *SANITIZER_ARGS[mode],
+           "-pthread", "-o", str(out), *[str(s) for s in srcs]]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        pytest.skip(f"sanitizer toolchain unavailable ({mode}): "
+                    f"{r.stderr[-500:]}")
+    return str(out)
+
+
+def _san_env() -> dict:
+    return {**os.environ,
+            "ASAN_OPTIONS": "detect_leaks=1:abort_on_error=0",
+            "UBSAN_OPTIONS": "print_stacktrace=1",
+            "TSAN_OPTIONS": "halt_on_error=0:exitcode=66"}
 
 
 @pytest.fixture(scope="module")
 def san_bin(tmp_path_factory):
-    out = tmp_path_factory.mktemp("san") / "px_native_san"
-    cmd = [
-        "g++", "-std=c++17", "-g", "-O1",
-        "-fsanitize=address,undefined", "-fno-omit-frame-pointer",
-        "-o", str(out),
-        str(NATIVE / "dictionary.cc"),
-        str(NATIVE / "stream_agg.cc"),
-        str(NATIVE / "sanitize" / "sanitize_main.cc"),
-    ]
-    r = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
-    if r.returncode != 0:
-        pytest.skip(f"sanitizer toolchain unavailable: {r.stderr[-500:]}")
-    return str(out)
+    return _build(tmp_path_factory, "px_native_san",
+                  [NATIVE / "dictionary.cc", NATIVE / "stream_agg.cc",
+                   NATIVE / "sanitize" / "sanitize_main.cc"], "address")
+
+
+_CONCURRENT_SRCS = [NATIVE / "dictionary.cc", NATIVE / "join.cc",
+                    NATIVE / "wholeplan.cc",
+                    NATIVE / "sanitize" / "concurrent_main.cc"]
+
+
+@pytest.fixture(scope="module")
+def concurrent_asan_bin(tmp_path_factory):
+    return _build(tmp_path_factory, "px_native_conc_asan",
+                  _CONCURRENT_SRCS, "address")
 
 
 def test_native_hot_loops_clean_under_asan_ubsan(san_bin):
-    import os
-
-    r = subprocess.run(
-        [san_bin], capture_output=True, text=True, timeout=300,
-        env={**os.environ,
-             "ASAN_OPTIONS": "detect_leaks=1:abort_on_error=0",
-             "UBSAN_OPTIONS": "print_stacktrace=1"})
+    r = subprocess.run([san_bin], capture_output=True, text=True,
+                       timeout=300, env=_san_env())
     assert r.returncode == 0, f"sanitizer failure:\n{r.stderr[-4000:]}"
+    assert "all checks passed" in r.stdout
+
+
+def test_native_concurrent_smoke_under_asan(concurrent_asan_bin):
+    """Tier-1 smoke: the concurrent driver (quick sizes) must be ASan/UBSan
+    clean — cross-thread heap misuse in the pthread paths fails here."""
+    r = subprocess.run([concurrent_asan_bin, "quick"], capture_output=True,
+                       text=True, timeout=300, env=_san_env())
+    assert r.returncode == 0, f"sanitizer failure:\n{r.stderr[-4000:]}"
+    assert "all checks passed" in r.stdout
+
+
+@pytest.mark.slow
+def test_native_pthread_paths_clean_under_tsan(tmp_path_factory):
+    """Slow lane: full-size concurrent driver under -fsanitize=thread
+    (PX_NATIVE_SANITIZE=thread is the operator knob selecting this mode;
+    'address' substitutes where the TSan runtime is unavailable)."""
+    from pixie_tpu import flags
+
+    mode = str(flags.get("PX_NATIVE_SANITIZE") or "thread")
+    if mode not in SANITIZER_ARGS:
+        pytest.skip(f"unknown PX_NATIVE_SANITIZE mode {mode!r}")
+    binary = _build(tmp_path_factory, f"px_native_conc_{mode}",
+                    _CONCURRENT_SRCS, mode)
+    r = subprocess.run([binary], capture_output=True, text=True,
+                       timeout=600, env=_san_env())
+    assert r.returncode == 0, (
+        f"{mode} sanitizer failure:\n{r.stdout[-1000:]}\n{r.stderr[-4000:]}")
     assert "all checks passed" in r.stdout

@@ -1,0 +1,216 @@
+"""pxlint unit + ratchet tests: each rule catches its seeded violation in
+synthetic sources, suppressions need reasons, and — the CI gate — the whole
+pixie_tpu package lints clean against the (empty) ratchet file.
+"""
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+from pixie_tpu.check import pxlint
+
+RATCHET = (pathlib.Path(pxlint.__file__).parent / "pxlint_ratchet.txt")
+
+
+def _lint_src(tmp_path, src: str, name: str = "mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(src))
+    return pxlint.lint_paths([str(f)])
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------- rules
+
+
+def test_lock_discipline_catches_unguarded_call(tmp_path):
+    fs = _lint_src(tmp_path, """
+        class C:
+            def _evict_locked(self):
+                pass
+
+            def bad(self):
+                self._evict_locked()
+
+            def good(self):
+                with self._lock:
+                    self._evict_locked()
+
+            def _also_locked(self):
+                self._evict_locked()  # held by contract
+    """)
+    assert _rules(fs) == ["lock-discipline"]
+    assert fs[0].line == 7
+
+
+def test_lock_discipline_owner_mapping(tmp_path):
+    fs = _lint_src(tmp_path, """
+        _pxlint_locks_ = {"_refresh_locked": "view.lock"}
+
+        class M:
+            def _refresh_locked(self, view):
+                pass
+
+            def wrong_lock(self, view):
+                with self._lock:
+                    self._refresh_locked(view)
+
+            def right_lock(self, view):
+                with view.lock:
+                    self._refresh_locked(view)
+    """)
+    assert _rules(fs) == ["lock-discipline"]
+    assert "view.lock" in fs[0].msg
+
+
+def test_env_read_outside_flags_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import os
+
+        def f():
+            a = os.environ.get("PX_FOO")
+            b = os.getenv("PL_BAR", "1")
+            c = os.environ["PIXIE_TPU_BAZ"]
+            d = "PX_QUX" in os.environ
+            ok = os.environ.get("PATH")  # not an engine flag
+            return a, b, c, d, ok
+    """)
+    assert _rules(fs) == ["env-read"] * 4
+
+
+def test_env_read_allowed_in_flags_py(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import os
+        V = os.environ.get("PX_ANYTHING")
+    """, name="flags.py")
+    assert fs == []
+
+
+def test_metric_hygiene_unregistered_and_nonliteral(tmp_path):
+    fs = _lint_src(tmp_path, """
+        from pixie_tpu import metrics
+
+        def f(name):
+            metrics.counter_inc("px_never_registered_total")
+            metrics.counter_inc(name)
+            metrics.gauge_set("not_px_prefixed", 1.0, help_="h")
+            metrics.counter_inc("px_fine_total", help_="documented")
+    """)
+    assert sorted(_rules(fs)) == ["metric-hygiene"] * 3
+
+
+def test_span_hygiene_bare_cm_and_raw_start_span(tmp_path):
+    fs = _lint_src(tmp_path, """
+        from pixie_tpu import trace
+
+        def f(tracer):
+            trace.span("dropped")          # never entered
+            sp = tracer.start_span("raw")  # bypasses the cm API
+            with trace.span("ok"):
+                pass
+            cm = trace.span("assigned")
+            with cm:
+                pass
+    """)
+    assert sorted(_rules(fs)) == ["span-hygiene"] * 2
+
+
+def test_jit_host_callback_flagged(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import jax
+
+        def traced(x):
+            print(x)
+            return x * 2
+
+        fast = jax.jit(traced)
+
+        def host_side(x):
+            print(x)  # fine: never traced
+            return x
+    """)
+    assert _rules(fs) == ["jit-host-callback"]
+
+
+# ------------------------------------------------------------- suppression
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import os
+        # pxlint: disable=env-read -- bootstrap read before flags import
+        V = os.environ.get("PX_BOOT")
+    """)
+    assert fs == []
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    fs = _lint_src(tmp_path, """
+        import os
+        # pxlint: disable=env-read
+        V = os.environ.get("PX_BOOT")
+    """)
+    assert "bad-suppression" in _rules(fs)
+    assert "env-read" in _rules(fs)  # the suppression did NOT apply
+
+
+def test_suppression_unknown_rule_is_a_finding(tmp_path):
+    fs = _lint_src(tmp_path, """
+        X = 1  # pxlint: disable=no-such-rule -- whatever
+    """)
+    assert _rules(fs) == ["bad-suppression"]
+
+
+# ----------------------------------------------------------------- ratchet
+
+
+def test_ratchet_absorbs_and_tightens(tmp_path):
+    f1 = pxlint.Finding("a.py", 1, "env-read", "x")
+    f2 = pxlint.Finding("a.py", 9, "env-read", "y")
+    allowed = {("a.py", "env-read"): 2}
+    net, stale = pxlint.apply_ratchet([f1, f2], allowed)
+    assert net == [] and stale == []
+    net, stale = pxlint.apply_ratchet([f1], allowed)
+    assert net == [] and stale and "tighten" in stale[0]
+    net, _ = pxlint.apply_ratchet([f1, f2], {})
+    assert len(net) == 2
+
+
+def test_ratchet_file_parses():
+    allowed = pxlint.load_ratchet(RATCHET)
+    assert isinstance(allowed, dict)
+
+
+# ------------------------------------------------------------ the CI gate
+
+
+def test_package_lints_clean_under_ratchet():
+    """The whole pixie_tpu package must lint clean (modulo the checked-in
+    ratchet, which is empty) — the tier-1 enforcement of the contract."""
+    findings = pxlint.lint_paths()
+    net, stale = pxlint.apply_ratchet(findings, pxlint.load_ratchet(RATCHET))
+    assert not net, "\n".join(str(f) for f in net)
+    assert not stale, "\n".join(stale)
+
+
+def test_cli_entrypoint_exits_zero():
+    r = subprocess.run(
+        [sys.executable, "-m", "pixie_tpu.check.pxlint",
+         "--ratchet", str(RATCHET)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_reports_violations(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\nV = os.environ.get('PX_X')\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "pixie_tpu.check.pxlint", str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "env-read" in r.stdout

@@ -174,6 +174,44 @@ def test_queue_timeout_sheds():
     assert front.stats()["queued"] == 0
 
 
+def test_timeout_racing_dispatch_never_double_resolves():
+    """Regression for the pxlint lock-discipline finding in admit(): the
+    timeout path used to read _retry_hint_locked's state (and decide the
+    shed) OUTSIDE the lock, so a dispatch racing the timeout could have its
+    'run' outcome overwritten with 'shed' — leaking the inflight slot.
+    Storm the exact window: a capacity-blocked ticket whose release lands
+    right at its queue timeout.  Whatever side wins, the ticket must
+    resolve exactly once and the accounting must return to zero."""
+    _set(PL_SERVING_ENABLED=1, PL_SERVING_MAX_INFLIGHT=1,
+         PL_SERVING_QUEUE_DEPTH=8)
+    front = ServingFront("t")
+    timeout_s = 0.03
+    for _ in range(30):
+        blocker = front.admit("a", COST_WARM)
+        out = {}
+
+        def admit(out=out):
+            try:
+                t = front.admit("a", COST_WARM, timeout_s=timeout_s)
+                out["ticket"] = t
+            except ShedError:
+                out["shed"] = True
+
+        th = threading.Thread(target=admit)
+        th.start()
+        time.sleep(timeout_s)  # release lands right at the timeout edge
+        front.release(blocker)
+        th.join(5.0)
+        assert not th.is_alive()
+        if "ticket" in out:  # dispatch won: it must be honored end-to-end
+            assert out["ticket"].outcome == "run"
+            front.release(out["ticket"])
+        else:
+            assert out.get("shed")
+        st = front.stats()
+        assert st["inflight"] == 0 and st["queued"] == 0, st
+
+
 def test_drr_weights_warm_over_cold():
     """One saturating cold tenant vs one warm tenant with equal queue
     pressure: DRR dispatches ~COST_COLD/COST_WARM warm queries per cold
