@@ -587,6 +587,27 @@ def bench_serving_load(clients, duration_s=8.0, rows=100_000):
     return {k: out[k] for k in keep if k in out}
 
 
+def bench_chaos_recovery_hard(queries, rows=24_576):
+    """`chaos_recovery_hard`: the durable-data-plane proof — kills are TRUE
+    pod losses (the faultinject `kill:` rule drops the victim's in-memory
+    store; alternate kills delete its PL_DATA_DIR too), recovery runs the
+    whole stack: journal replay, sealed-batch replication, broker failover
+    onto promoted replicas, peer-fetch rehydration.  The guard block holds
+    row_loss == 0, bit_equal_frac == 1.0, client_errors == 0 ABSOLUTELY,
+    plus a recovery-time budget."""
+    from pixie_tpu.services.chaos_bench import run_chaos_hard
+
+    try:
+        out = run_chaos_hard(queries=queries, rows=rows)
+    except Exception as e:  # the bench round must survive a harness failure
+        return {"rows": queries, "error": f"{type(e).__name__}: {e}"[:200]}
+    keep = ("rows", "ingest_rows", "kills", "wipe_kills",
+            "row_loss", "recovery_rate", "bit_equal_frac", "client_errors",
+            "recovery_s_max", "journal_replayed_rows",
+            "repl_rehydrated_rows", "failover_serves")
+    return {k: out[k] for k in keep if k in out}
+
+
 def bench_chaos_recovery(queries, rows=200_000):
     """`chaos_recovery`: replay a fixed retryable query set against a real
     broker+agent deployment under an injected agent kill-and-restart
@@ -875,6 +896,7 @@ def main():
                                                args.repeats)
     serving = bench_serving_load(args.serving_clients)
     chaos = bench_chaos_recovery(args.chaos_queries)
+    chaos_hard = bench_chaos_recovery_hard(max(args.chaos_queries // 2, 12))
     sharded = bench_sharded_agg(args.rows, args.repeats)
     cfg3, cfg3_busy = bench_config3(args.join_rows, args.repeats)
     dj_rows = min(args.join_rows, 16_000_000)
@@ -914,6 +936,7 @@ def main():
             "wholeplan_native_unit": wholeplan,
             "serving_load": serving,
             "chaos_recovery": chaos,
+            "chaos_recovery_hard": chaos_hard,
             "sharded_agg_64m": sharded,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
@@ -1165,6 +1188,16 @@ ABS_FLOORS = [
     # the schedule must actually have killed agents — a run where nothing
     # died proves nothing
     ("configs.chaos_recovery.kills", 1.0, 80),
+    # chaos_recovery_hard acceptance (ISSUE 12): TRUE pod losses (store
+    # dropped; alternate kills wipe the data dir too) still recover every
+    # query bit-equal, and both recovery paths actually ran — kills with a
+    # journal replay AND wipe-kills with a peer-fetch rehydration
+    ("configs.chaos_recovery_hard.recovery_rate", 1.0, 40),
+    ("configs.chaos_recovery_hard.bit_equal_frac", 1.0, 40),
+    ("configs.chaos_recovery_hard.kills", 2.0, 40),
+    ("configs.chaos_recovery_hard.wipe_kills", 1.0, 40),
+    ("configs.chaos_recovery_hard.journal_replayed_rows", 1.0, 40),
+    ("configs.chaos_recovery_hard.repl_rehydrated_rows", 1.0, 40),
 ]
 
 #: absolute ceilings (key path, ceiling, shape rows) — the serving
@@ -1182,6 +1215,12 @@ ABS_CEILINGS = [
     # ceiling is backoff rounds + one re-execution, never an open stall)
     ("configs.chaos_recovery.client_errors", 0.0, 80),
     ("configs.chaos_recovery.added_p99_ms", 5000.0, 80),
+    # the durability acceptance: ZERO acknowledged rows lost across store
+    # drops and data-dir wipes, zero client-visible errors, and a restarted
+    # agent back to serving within the recovery budget
+    ("configs.chaos_recovery_hard.row_loss", 0.0, 40),
+    ("configs.chaos_recovery_hard.client_errors", 0.0, 40),
+    ("configs.chaos_recovery_hard.recovery_s_max", 10.0, 40),
 ]
 
 

@@ -447,6 +447,24 @@ def on_retention_trim(table_uid: int, oldest_retained_gen) -> None:
                 e.trim_to = max(e.trim_to or 0, oldest_retained_gen)
 
 
+def drop_table(table_uid: int) -> None:
+    """Free every resident entry for one table NOW — the pinned-tier
+    invalidation hook for shard-map changes: a replica dropping a dead
+    primary's takeover store (services/replication.py) must not leave that
+    store's columns pinned in HBM.  Cheap bookkeeping only, same contract
+    as on_retention_trim."""
+    global _TIER_BYTES
+    with _LOCK:
+        for key in [k for k in _TIER if k[0] == table_uid]:
+            e = _TIER.pop(key)
+            _TIER_BYTES -= e.nbytes
+            stats["trims"] += 1
+            _metrics.counter_inc(
+                "px_resident_shard_map_evictions_total",
+                help_="resident entries freed by shard-map / takeover-store "
+                      "invalidation")
+
+
 def tier_stats() -> dict:
     with _LOCK:
         return {"entries": len(_TIER), "bytes": _TIER_BYTES, **stats}

@@ -177,8 +177,20 @@ class MatViewManager:
         self._views: dict[str, StandingView] = {}
         self._lock = threading.Lock()
         self._ticker = None
+        #: durable standing-state snapshots (PL_DATA_DIR): folding refreshes
+        #: persist the mergeable partial state + watermark, and a restarted
+        #: agent ADOPTS the snapshot at first sight instead of rescanning —
+        #: refresh resumes at O(delta) after a pod restart
+        self.snapshot_dir: Optional[str] = None
         _MANAGERS.add(self)
         _register_gauges()
+
+    def set_snapshot_dir(self, path: Optional[str]) -> None:
+        if path:
+            import os
+
+            os.makedirs(path, exist_ok=True)
+        self.snapshot_dir = path or None
 
     # ---------------------------------------------------------------- lookup
     def _resolve_table(self, head) -> Optional[Table]:
@@ -229,15 +241,27 @@ class MatViewManager:
         key = view_key(pref)
         if ns:
             key = f"{ns}:{key}"
+        fresh = False
         with self._lock:
             view = self._views.get(key)
             if view is None:
                 # first sight: register only.  Anchoring the cursor NOW means
                 # the second run folds [frontier-at-first-sight, head) — the
                 # same rows the first run scanned plus whatever arrived since.
-                self._views[key] = StandingView(key, pref, table, ns=ns)
+                # With a durable snapshot on disk the state ADOPTS instead
+                # (outside the manager lock — refresh_all's pop path orders
+                # view.lock before it): the first sight after a restart
+                # already serves, folding only the post-snapshot delta.
+                view = self._views[key] = StandingView(key, pref, table,
+                                                       ns=ns)
+                fresh = True
+        if fresh:
+            with view.lock:
+                adopted = self._try_adopt_snapshot(view, table)
+            if not adopted:
                 metrics.counter_inc(
-                    "px_matview_misses_total", labels={"reason": "register"},
+                    "px_matview_misses_total",
+                    labels={"reason": "register"},
                     help_="view lookups that could not serve standing state")
                 return None
         t0 = time.perf_counter()
@@ -253,6 +277,9 @@ class MatViewManager:
             view.hits += 1
             view.last_access = time.monotonic()
             state = view.state
+        snap = info.pop("_snap", None)
+        if snap is not None:
+            self._save_snapshot(key, view.prefix.head.table, *snap)
         self._evict_over_budget(keep=key)
         info["hit"] = True
         info["serve_ms"] = round((time.perf_counter() - t0) * 1000, 3)
@@ -335,12 +362,7 @@ class MatViewManager:
             # post-fold check: if expiry raced the fold (trimmed past base
             # while we scanned), the state is tainted — rebuild once.
             if view.cursor.status(table) == CURSOR_OK:
-                if folded:
-                    # only re-walk the state when it actually changed: the
-                    # size walk is O(groups) Python (str() per object key),
-                    # too slow for the empty-delta poll hot path
-                    view.state_bytes = _pb_nbytes(view.state)
-                return {
+                out = {
                     "view": view.key,
                     "rows_folded": rows,
                     "refresh_ms": round((time.perf_counter() - tr0) * 1000, 3),
@@ -349,8 +371,111 @@ class MatViewManager:
                     "watermark": view.cursor.watermark,
                     "rebuilt": rebuilt,
                 }
+                if folded:
+                    # only re-walk the state when it actually changed: the
+                    # size walk is O(groups) Python (str() per object key),
+                    # too slow for the empty-delta poll hot path
+                    view.state_bytes = _pb_nbytes(view.state)
+                    out["state_bytes"] = view.state_bytes
+                    if self.snapshot_dir is not None:
+                        # capture under the lock, WRITE after release: the
+                        # snapshot fsync must not serialize concurrent
+                        # serves of this view (same rule as Table.write's
+                        # journal append).  state is replaced, never
+                        # mutated, so the captured reference is stable.
+                        out["_snap"] = (view.state, view.cursor.watermark,
+                                        view.cursor.base_row_id)
+                return out
             rebuilt = view.cursor.status(table)
         return None
+
+    # ------------------------------------------------------------- snapshots
+    def _snap_path(self, key: str) -> str:
+        import hashlib
+        import os
+
+        return os.path.join(self.snapshot_dir,
+                            hashlib.sha1(key.encode()).hexdigest() + ".snap")
+
+    def _save_snapshot(self, key: str, table_name: str, state, wm: int,
+                       base: int) -> None:
+        """Persist the mergeable partial state + watermark (runs OUTSIDE
+        the view lock — the fsync must not serialize serves; the state
+        reference is replace-on-refresh immutable).  One CRC-framed wire
+        partial_agg record, written atomically — a crash mid-write leaves
+        the previous snapshot intact, and a torn record is rejected at
+        adoption by its CRC."""
+        if self.snapshot_dir is None or state is None:
+            return
+        import os
+
+        from pixie_tpu.services import wire
+        from pixie_tpu.table import journal as _journal
+
+        try:
+            payload = wire.encode_partial_agg(state, {
+                "snap_key": key, "table": table_name,
+                "wm": int(wm), "base": int(base),
+            })
+            path = self._snap_path(key)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(_journal.pack_record(payload))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            metrics.counter_inc(
+                "px_matview_snapshots_total",
+                help_="standing-view state snapshots persisted")
+        except Exception:
+            metrics.counter_inc(
+                "px_matview_snapshot_errors_total",
+                help_="failed standing-view snapshot writes (state stays "
+                      "memory-only; next refresh retries)")
+
+    def _try_adopt_snapshot(self, view: StandingView, table) -> bool:
+        """Restore a persisted snapshot into a freshly registered view
+        (view.lock held).  Adoption requires scan-equivalence: the snapshot
+        base must sit exactly at the table's live retention frontier (state
+        covering trimmed rows — or missing retained ones — would diverge
+        from a cold rescan) and the watermark must not run ahead of the
+        restored rows."""
+        if self.snapshot_dir is None:
+            return False
+        import os
+
+        from pixie_tpu.services import wire
+        from pixie_tpu.table import journal as _journal
+
+        path = self._snap_path(view.key)
+        if not os.path.exists(path):
+            return False
+        try:
+            payloads, _valid, _clean = _journal.scan_segment(path)
+            if not payloads:
+                return False
+            kind, pb = wire.decode_frame(payloads[0])
+            if kind != "partial_agg":
+                return False
+            meta = pb.wire_meta
+            if (meta.get("snap_key") != view.key
+                    or meta.get("table") != view.prefix.head.table):
+                return False
+            base, wm = int(meta["base"]), int(meta["wm"])
+            if base != table.first_row_id() or wm > table.last_row_id():
+                return False
+            view.state = pb
+            view.cursor.table_uid = table.uid
+            view.cursor.base_row_id = base
+            view.cursor.watermark = wm
+            view.state_bytes = _pb_nbytes(pb)
+            metrics.counter_inc(
+                "px_matview_snapshot_restores_total",
+                help_="standing views restored from durable snapshots "
+                      "(refresh resumed at O(delta) after restart)")
+            return True
+        except Exception:
+            return False
 
     def _compute_partial(self, pref: ViewPrefix, lo: int, hi: int,
                          route_scale: int, mesh) -> tuple:
@@ -387,10 +512,15 @@ class MatViewManager:
         for view in views:
             table = self._resolve_table(view.prefix.head)
             with view.lock:
-                if table is None or self._refresh_locked(view, table) is None:
+                info = (self._refresh_locked(view, table)
+                        if table is not None else None)
+                if info is None:
                     with self._lock:
                         self._views.pop(view.key, None)
                     continue
+            snap = info.pop("_snap", None)
+            if snap is not None:
+                self._save_snapshot(view.key, view.prefix.head.table, *snap)
             ok += 1
         self._evict_over_budget()
         return ok

@@ -25,6 +25,29 @@ def _key(name: str, labels: Optional[dict]) -> tuple:
     return (name, tuple(sorted((labels or {}).items())))
 
 
+#: per-family capped label-id sets (see capped_label)
+_label_ids: dict[str, set] = {}
+MAX_LABEL_IDS = 256
+OTHER_LABEL = "__other__"
+
+
+def capped_label(family: str, ident: str, cap: int = MAX_LABEL_IDS) -> str:
+    """Bound the distinct label values one id-space (tenant ids, agent
+    names) can mint: the first `cap` ids get their own series, everything
+    after shares OTHER_LABEL.  Counter series in this registry are
+    immortal, so an unbounded id flood would otherwise grow process memory
+    (and /metrics output) forever."""
+    ident = str(ident)
+    with _lock:
+        s = _label_ids.setdefault(family, set())
+        if ident in s:
+            return ident
+        if len(s) < cap:
+            s.add(ident)
+            return ident
+    return OTHER_LABEL
+
+
 def counter_inc(name: str, value: float = 1.0, labels: Optional[dict] = None,
                 help_: str = "") -> None:
     with _lock:
@@ -176,6 +199,7 @@ def reset_for_testing() -> None:
         _gauge_fns.clear()
         _hists.clear()
         _help.clear()
+        _label_ids.clear()
 
 
 # ------------------------------------------------------------------- logging

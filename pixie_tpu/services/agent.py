@@ -20,8 +20,10 @@ from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.matview import MatViewManager
 from pixie_tpu.parallel.partial import PartialAggBatch
 from pixie_tpu.plan.plan import Plan
+from pixie_tpu.services import replication as _replication
 from pixie_tpu.services import wire
 from pixie_tpu.services.transport import Connection, dial
+from pixie_tpu.table import journal as _journal
 from pixie_tpu.table.table import TableStore
 
 DEFAULT_HEARTBEAT_S = 5.0  # reference manager/heartbeat.h:79
@@ -108,6 +110,14 @@ class Agent:
         #: req_id → in-flight window semaphore; chunk_ack frames release it
         self._windows: dict[str, threading.Semaphore] = {}
         self._windows_lock = threading.Lock()
+        #: durable data plane (PL_DATA_DIR / PL_REPLICATION): set in start()
+        self.replication = None
+        self.rehydrate_stats: dict = {}
+        self._owns_journal = False
+        self.pod_killed = threading.Event()
+        #: broker RPC slots (get_peers): req_id -> [Event, reply]
+        self._replies: dict[str, list] = {}
+        self._replies_lock = threading.Lock()
 
     # ---------------------------------------------------------------- lifecycle
     def start(self, timeout: float = 10.0) -> "Agent":
@@ -116,11 +126,17 @@ class Agent:
             self.collector.start()
         self.conn = dial(*self.broker, on_frame=self._on_frame)
         # fault-injection target (services/faultinject.py): chaos plans
-        # address this agent's broker link as "agent:<name>"
+        # address this agent's broker link as "agent:<name>"; kill rules
+        # (true pod loss) route back into _pod_kill through the handler
+        # registry so the store drops with the connection
         self.conn.label = f"agent:{self.name}"
+        from pixie_tpu.services import faultinject as _faultinject
+
+        _faultinject.register_kill_handler(self.conn.label, self._pod_kill)
         if self.auth_token is not None:
             self.conn.send(wire.encode_json(
                 {"msg": "auth", "token": self.auth_token}))
+        self._rehydrate(timeout)
         self._register()
         if not self._registered.wait(timeout=timeout):
             raise TimeoutError(f"agent {self.name}: broker did not ack registration")
@@ -140,8 +156,83 @@ class Agent:
             self.healthz.stop()
         if self.collector is not None:
             self.collector.stop()
+        from pixie_tpu.services import faultinject as _faultinject
+
+        # only OUR handler: a restarted successor owns the label now
+        _faultinject.unregister_kill_handler(f"agent:{self.name}",
+                                             fn=self._pod_kill)
+        if self.replication is not None:
+            self.replication.stop()
+            self.replication = None
+        if self._owns_journal:
+            _journal.detach_store(self.store)
+            self._owns_journal = False
         if self.conn is not None:
             self.conn.close()
+
+    # ------------------------------------------------------------- durability
+    def _rehydrate(self, timeout: float) -> None:
+        """Restore durable state BEFORE registration, so the broker never
+        dispatches to a store that is still catching up: (1) journal replay
+        into the local store (acked rows survive restart), (2) peer fetch
+        of sealed batches the journal no longer covers (pod loss), (3) the
+        matview snapshot dir arms so standing state resumes at O(delta).
+        A no-op with PL_DATA_DIR unset and PL_REPLICATION=1."""
+        ndir = _journal.node_dir(self.name)
+        if ndir is not None:
+            self.rehydrate_stats["journal"] = _journal.attach_store(
+                self.store, ndir)
+            self._owns_journal = True
+            import os as _os
+
+            self.matviews.set_snapshot_dir(_os.path.join(ndir, "matview"))
+        if not _replication.enabled():
+            return
+        self.replication = _replication.ReplicationManager(
+            self.name, self.store).start()
+        try:
+            reply = self._rpc({"msg": "get_peers", "agent": self.name},
+                              timeout=timeout)
+        except TimeoutError:
+            return  # an old broker: replicate-only mode, no topology yet
+        shard_map = reply.get("shard_map") or {}
+        peers = reply.get("peers") or {}
+        self.replication.on_shard_map(shard_map, peers)
+        holders = [h for h in (shard_map.get(self.name) or []) if h in peers]
+        if holders:
+            self.rehydrate_stats["fetch"] = self.replication.fetch_missing(
+                self.store, holders)
+
+    def _pod_kill(self) -> None:
+        """True pod loss (faultinject `kill:` rule): drop every in-memory
+        table — recovery must come from the journal and the replica peers,
+        never from preserved process state."""
+        self.pod_killed.set()
+        self._stop.set()
+        if self._owns_journal:
+            _journal.detach_store(self.store)
+            self._owns_journal = False
+        if self.replication is not None:
+            self.replication.stop()
+            self.replication = None
+        for n in list(self.store.names()):
+            self.store.drop(n)
+
+    def _rpc(self, meta: dict, timeout: float = 10.0) -> dict:
+        import uuid as _uuid
+
+        rid = meta.setdefault("req_id", _uuid.uuid4().hex)
+        slot = [threading.Event(), None]
+        with self._replies_lock:
+            self._replies[rid] = slot
+        try:
+            self.conn.send(wire.encode_json(meta))
+            if not slot[0].wait(timeout):
+                raise TimeoutError(f"broker did not answer {meta.get('msg')}")
+            return slot[1]
+        finally:
+            with self._replies_lock:
+                self._replies.pop(rid, None)
 
     def _register(self):
         self.conn.send(wire.encode_json({
@@ -149,6 +240,8 @@ class Agent:
             "agent": self.name,
             "schemas": {t: r.to_dict() for t, r in self.store.schemas().items()},
             "n_devices": self.n_devices,
+            "repl_addr": (list(self.replication.addr())
+                          if self.replication is not None else None),
         }))
 
     def _hb_loop(self):
@@ -170,17 +263,33 @@ class Agent:
             # broker consumed (folded) one of our chunk frames: open the
             # in-flight window by one.  MUST stay on the read loop — it's a
             # lone semaphore release, and a thread per ack would cost more
-            # than the fold it acknowledges.  Keyed per (req_id, attempt):
-            # a hedged duplicate dispatch runs concurrently with its twin
-            # and must not drain the twin's window.
+            # than the fold it acknowledges.  Keyed per (req_id, attempt,
+            # source agent): a hedged duplicate dispatch runs concurrently
+            # with its twin, and a failover replica may stream its OWN
+            # fragment beside a takeover fragment of the same query —
+            # neither must drain the other's window.
             key = (f"{payload.get('req_id', '')}"
-                   f"#{int(payload.get('attempt') or 0)}")
+                   f"#{int(payload.get('attempt') or 0)}"
+                   f"#{payload.get('agent') or self.name}")
             with self._windows_lock:
                 sem = self._windows.get(key)
             if sem is not None:
                 sem.release()
         elif msg == "reregister":
             self._register()
+        elif msg == "peers":
+            # reply to a get_peers RPC (rehydration topology fetch)
+            with self._replies_lock:
+                slot = self._replies.get(payload.get("req_id"))
+            if slot is not None:
+                slot[1] = payload
+                slot[0].set()
+        elif msg == "shard_map":
+            # broker push on topology change: retarget replication and drop
+            # takeover materializations for primaries this node left
+            if self.replication is not None:
+                self.replication.on_shard_map(payload.get("map") or {},
+                                              payload.get("peers") or {})
         elif msg == "execute":
             threading.Thread(
                 target=self._execute, args=(payload,), daemon=True,
@@ -224,7 +333,16 @@ class Agent:
         # re-dispatches and hedged duplicates of the same query.
         qtoken = meta.get("qtoken")
         attempt = int(meta.get("attempt") or 0)
-        wkey = f"{req_id}#{attempt}"
+        # failover takeover: the broker dispatched a DEAD primary's fragment
+        # here — execute it over the store materialized from that primary's
+        # replicated sealed batches, and answer AS the primary (src/token
+        # bookkeeping at the broker is keyed by the planned agent name)
+        serve_for = meta.get("serve_for")
+        src_name = str(serve_for) if serve_for else self.name
+        # the window key carries the SOURCE name: a replica can run its own
+        # fragment AND a takeover fragment of the same (req, attempt) — two
+        # streams, two windows; a shared key would starve one of its acks
+        wkey = f"{req_id}#{attempt}#{src_name}"
         # cross-process trace context: parent this agent's exec spans under
         # the broker's dispatch span for the same query
         tctx = meta.get("trace")
@@ -243,12 +361,22 @@ class Agent:
         try:
             with cm:
                 plan = Plan.from_dict(meta["plan"])
+                exec_store = self.store
+                if serve_for:
+                    if self.replication is None:
+                        raise RuntimeError(
+                            f"takeover dispatch for {serve_for} without "
+                            "replication enabled")
+                    exec_store = self.replication.takeover_store(
+                        str(serve_for))
                 # Standing-view fast path: an eligible repeated plan answers
                 # from incrementally refreshed partial-agg state (first sight
                 # only registers and runs the normal path below).  analyze
                 # runs bypass views — they exist to measure the real scan.
+                # Takeover serves bypass them too: standing state is bound to
+                # THIS node's store, not the materialized primary shard.
                 served = None
-                if not meta.get("analyze"):
+                if not meta.get("analyze") and not serve_for:
                     served = self.matviews.serve(
                         plan, route_scale=int(meta.get("route_scale", 1)),
                         tenant=str(meta.get("tenant") or ""),
@@ -261,7 +389,7 @@ class Agent:
                 else:
                     mv_info = None
                     ex = PlanExecutor(
-                        plan, self.store, self.registry,
+                        plan, exec_store, self.registry,
                         analyze=bool(meta.get("analyze", False)),
                         route_scale=int(meta.get("route_scale", 1)),
                     )
@@ -282,7 +410,7 @@ class Agent:
                     counts[channel] = seq + 1
                     extra = {"msg": "chunk", "req_id": req_id,
                              "channel": channel, "seq": seq,
-                             "agent": self.name, "qtoken": qtoken,
+                             "agent": src_name, "qtoken": qtoken,
                              "attempt": attempt}
                     if isinstance(payload, PartialAggBatch):
                         frame = wire.encode_partial_agg(payload, extra)
@@ -294,6 +422,12 @@ class Agent:
                 stats = dict(ex.stats) if ex is not None else {}
                 if mv_info is not None:
                     stats["matview"] = mv_info
+                if serve_for:
+                    # completeness accounting: the broker folds this into
+                    # stats["fault"]["failover"] so a degraded (replica-
+                    # served) answer is auditable per query
+                    stats["takeover"] = {"primary": src_name,
+                                         "replica": self.name}
                 stats["exec_s"] = time.perf_counter() - t0
             # spans persist BEFORE the ack: when exec_done lands at the
             # broker, this query's spans are already scannable
@@ -301,7 +435,7 @@ class Agent:
             from pixie_tpu.services.broker import _jsonable
 
             self.conn.send(wire.encode_json({
-                "msg": "exec_done", "req_id": req_id, "agent": self.name,
+                "msg": "exec_done", "req_id": req_id, "agent": src_name,
                 "qtoken": qtoken, "attempt": attempt,
                 "stats": _jsonable(stats),
                 # per-channel chunk counts: the broker verifies its folds saw
@@ -312,7 +446,7 @@ class Agent:
         except Exception as e:
             self._flush_trace()
             self.conn.send(wire.encode_json({
-                "msg": "exec_error", "req_id": req_id, "agent": self.name,
+                "msg": "exec_error", "req_id": req_id, "agent": src_name,
                 "qtoken": qtoken, "attempt": attempt, "error": str(e),
             }))
         finally:

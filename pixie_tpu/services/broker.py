@@ -26,6 +26,7 @@ from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.parallel.distributed import DistributedPlanner
 from pixie_tpu.serving import COST_COLD, COST_WARM, ServingFront, ShedError
+from pixie_tpu.services import replication as _replication
 from pixie_tpu.services import wire
 from pixie_tpu.services.kvstore import KVStore
 from pixie_tpu.services.registry import AgentRegistry
@@ -113,6 +114,11 @@ class _QueryCtx:
         import secrets
 
         self.lock = threading.RLock()
+        #: dead primary → live replica serving its shard this query
+        #: (sealed-batch replication failover); {} without replication
+        self.failover: dict[str, str] = {}
+        #: failover routes actually dispatched (→ stats["fault"])
+        self.failover_used: dict[str, str] = {}
         #: False for tracepoint-deploy rounds: agent loss fails the round
         #: immediately (mutations are never transparently re-dispatched)
         self.retryable = retryable
@@ -188,7 +194,8 @@ class _QueryCtx:
         return f"{meta.get('agent')}#{int(meta.get('attempt') or 0)}"
 
     def register_dispatch(self, agent: str, frag=None, deadline=None,
-                          hedged: bool = False, token: Optional[str] = None):
+                          hedged: bool = False, token: Optional[str] = None,
+                          via: Optional[str] = None):
         import secrets
         import time as _time
 
@@ -201,6 +208,10 @@ class _QueryCtx:
             self.pending[src] = {
                 "agent": agent, "attempt": attempt, "frag": frag,
                 "deadline": deadline, "hedged": hedged,
+                # the agent whose CONNECTION carries this dispatch: the
+                # planned agent itself, or its failover replica — eviction
+                # of the carrier must drop the dispatch either way
+                "via": via or agent,
                 "t0": _time.monotonic(),
             }
             if hedged:
@@ -294,7 +305,8 @@ class _QueryCtx:
         agent whose result was already accepted is a no-op — its data is
         folded and verified; its later death cannot poison this query."""
         with self.lock:
-            srcs = [s for s, i in self.pending.items() if i["agent"] == agent]
+            srcs = [s for s, i in self.pending.items()
+                    if i["agent"] == agent or i.get("via") == agent]
             for s in srcs:
                 self.pending.pop(s, None)
             affected = bool(srcs) or (agent in self.needed_agents
@@ -658,6 +670,27 @@ class Broker:
                         for c in self.cron.list()
                     ],
                 }))
+            elif msg == "deregister_agent":
+                # operator decommission: drop the durable record so the
+                # shard map stops treating the retired node as a failover
+                # primary (and catch-up degradation clears)
+                ok = self.registry.deregister(str(payload.get("agent")))
+                conn.send(wire.encode_json({
+                    "msg": "ok" if ok else "error",
+                    "req_id": payload.get("req_id"),
+                    **({} if ok else {"error": "unknown agent"})}))
+                if ok:
+                    self._push_shard_map()
+            elif msg == "get_peers":
+                # pre-registration topology fetch: a rehydrating agent asks
+                # who backs its shard (and where their replication ports
+                # live) BEFORE it registers, so peer fetch completes before
+                # the broker ever dispatches to it
+                conn.send(wire.encode_json({
+                    "msg": "peers", "req_id": payload.get("req_id"),
+                    "shard_map": self.registry.shard_map(),
+                    "peers": self.registry.peer_addrs(),
+                }))
             elif msg == "list_schemas":
                 conn.send(wire.encode_json({
                     "msg": "schemas",
@@ -731,18 +764,89 @@ class Broker:
             "px_agent_evictions_total",
             help_="agent connections lost (disconnect, heartbeat expiry, "
                   "or supersede by a re-registration)")
+        # per-agent series ride a CAPPED label: agent names arrive on the
+        # wire, so an id flood must not mint immortal counter series past
+        # the cap (same policy as the PR 8 tenant-label cap)
+        _metrics.counter_inc(
+            "px_agent_evictions_by_agent_total",
+            labels={"agent": _metrics.capped_label("agent", name)},
+            help_="agent evictions by (capped) agent name")
         with self._qlock:
             ctxs = list(self._queries.values())
         for ctx in ctxs:
             for src in ctx.on_agent_lost(name, reason):
                 self._finish_dispatch_span(ctx, src,
                                            error=f"agent {name} {reason}")
+        self._push_shard_map()
+
+    # ------------------------------------------------------------ durability
+    def _push_shard_map(self) -> None:
+        """Broadcast the registry's primary→replicas map + peer addresses
+        to every live agent connection, and flag catch-up on the serving
+        front (dead primaries being served by failover replicas degrade
+        dispatch until they rehydrate).  No-op with replication off."""
+        if not _replication.enabled():
+            return
+        m = self.registry.shard_map()
+        peers = self.registry.peer_addrs()
+        self.serving.set_catchup(len(self._failover_map(m)))
+        frame = wire.encode_json({"msg": "shard_map", "map": m,
+                                  "peers": peers})
+        for _name, conn in sorted(self._agent_conns.items()):
+            if not conn.closed:
+                conn.send(frame)
+
+    def _failover_map(self, shard_map: Optional[dict] = None) -> dict:
+        """{dead primary → live replica} for every known-dead agent whose
+        shard map lists a replica with a live connection.  Empty unless
+        replication is enabled."""
+        if not _replication.enabled():
+            return {}
+        if shard_map is None:
+            shard_map = self.registry.shard_map()
+        live = {r.name for r in self.registry.live_agents()}
+        out: dict[str, str] = {}
+        for primary, reps in sorted(shard_map.items()):
+            if primary in live:
+                continue
+            rec = self.registry.record(primary)
+            if rec is None or not rec.schemas:
+                continue
+            for r in reps or []:
+                conn = self._agent_conns.get(r)
+                if r in live and conn is not None and not conn.closed:
+                    out[primary] = r
+                    break
+        return out
+
+    def _spec_with_failover(self, spec, failover: dict):
+        """Planner topology with the failover map's dead primaries added
+        back as virtual data agents (their durable schemas come from the
+        registry records).  The merger stays last."""
+        from pixie_tpu.parallel.topology import AgentInfo, ClusterSpec
+
+        have = {a.name for a in spec.agents}
+        extra = []
+        for primary in sorted(failover):
+            if primary in have:
+                continue
+            rec = self.registry.record(primary)
+            if rec is None:
+                continue
+            extra.append(AgentInfo(
+                name=primary, has_data_store=True, processes_data=True,
+                accepts_remote_sources=False, schemas=rec.schemas,
+                n_devices=rec.n_devices))
+        if not extra:
+            return spec
+        return ClusterSpec(spec.agents[:-1] + extra + spec.agents[-1:])
 
     # ---------------------------------------------------------------- handlers
     def _handle_register(self, conn: Connection, meta: dict):
         name = meta["agent"]
         schemas = {t: Relation.from_dict(r) for t, r in meta["schemas"].items()}
-        asid = self.registry.register(name, schemas, meta.get("n_devices"))
+        asid = self.registry.register(name, schemas, meta.get("n_devices"),
+                                      repl_addr=meta.get("repl_addr"))
         conn.state["agent"] = name
         # the incarnation this socket speaks for — older sockets for the
         # same name are fenced from here on (_stale_incarnation)
@@ -761,6 +865,9 @@ class Broker:
             # process never saw them): evict so they re-dispatch to the
             # fresh incarnation
             self._evict_agent(name, "superseded")
+        # topology changed: replicas retarget, rehydrated shards leave
+        # catch-up, takeover materializations for this name invalidate
+        self._push_shard_map()
 
     def _ctx(self, meta: dict) -> Optional[_QueryCtx]:
         """Resolve the query ctx for a producer frame, enforcing the
@@ -811,6 +918,10 @@ class Broker:
                 "msg": "chunk_ack", "req_id": meta.get("req_id"),
                 "channel": meta["channel"], "seq": meta.get("seq"),
                 "attempt": meta.get("attempt"),
+                # the SOURCE the chunk answered for (≠ the executing agent
+                # on a failover takeover): the producer's ack-window key
+                # includes it, so two streams on one socket stay distinct
+                "agent": meta.get("agent"),
             }))
 
     def _finish_dispatch_span(self, ctx: _QueryCtx, src,
@@ -821,18 +932,31 @@ class Broker:
                 sp.attributes["error"] = error[:200]
             self.tracer.finish(sp)
 
+    #: distinct agents the service-time model tracks; like metric label
+    #: series, the dict is keyed by wire-supplied names and would otherwise
+    #: grow without bound — past the cap the least-recently-updated entry
+    #: is evicted (a re-appearing agent just re-warms)
+    MAX_SVC_AGENTS = 256
+
     def _record_service_time(self, agent: str, secs: float) -> None:
         """Fold one dispatch→exec_done sample into the agent's EWMA model
         (hedge deadlines derive from it)."""
+        import time as _time
+
         a = 0.2
         with self._svc_lock:
             s = self._svc.get(agent)
             if s is None:
-                self._svc[agent] = {"ewma": secs, "dev": secs / 2, "n": 1}
+                if len(self._svc) >= self.MAX_SVC_AGENTS:
+                    lru = min(self._svc, key=lambda k: self._svc[k]["at"])
+                    self._svc.pop(lru, None)
+                self._svc[agent] = {"ewma": secs, "dev": secs / 2, "n": 1,
+                                    "at": _time.monotonic()}
                 return
             s["ewma"] += a * (secs - s["ewma"])
             s["dev"] += a * (abs(secs - s["ewma"]) - s["dev"])
             s["n"] += 1
+            s["at"] = _time.monotonic()
 
     def _hedge_deadline_s(self, agent: str) -> Optional[float]:
         """Seconds a dispatch to `agent` may run before a hedged duplicate
@@ -1036,8 +1160,24 @@ class Broker:
         from pixie_tpu.status import Unavailable
 
         conn = self._agent_conns.get(agent)
+        serve_for = None
         if conn is None or conn.closed:
-            raise Unavailable(f"agent {agent} not connected")
+            # failover: a dead primary's fragment dispatches to its live
+            # replica, which serves it from the replicated sealed batches
+            # (takeover store) and answers AS the primary
+            replica = ctx.failover.get(agent)
+            rconn = (self._agent_conns.get(replica)
+                     if replica is not None else None)
+            if rconn is None or rconn.closed:
+                raise Unavailable(f"agent {agent} not connected")
+            conn, serve_for = rconn, agent
+            ctx.failover_used[agent] = replica
+            from pixie_tpu import metrics as _metrics
+
+            _metrics.counter_inc(
+                "px_broker_failover_dispatches_total",
+                help_="fragments dispatched to failover replicas for dead "
+                      "primaries")
         deadline = None
         if not hedged:
             h = self._hedge_deadline_s(agent)
@@ -1046,7 +1186,8 @@ class Broker:
 
                 deadline = _time.monotonic() + h
         src, token, attempt = ctx.register_dispatch(
-            agent, frag=plan_json, deadline=deadline, hedged=hedged)
+            agent, frag=plan_json, deadline=deadline, hedged=hedged,
+            via=(ctx.failover.get(agent) if serve_for else None))
         # one dispatch span per src: opened at send, closed by the
         # exec_done/exec_error handler (or eviction cleanup); its id rides
         # the wire so the agent's exec spans parent under it cross-process
@@ -1059,6 +1200,8 @@ class Broker:
         meta = dict(base_meta)
         meta.update({"req_id": req_id, "qtoken": token, "attempt": attempt,
                      "trace": tctx})
+        if serve_for is not None:
+            meta["serve_for"] = serve_for
         # splice the cached plan JSON (encoded once per plan/split, not per
         # query) instead of re-serializing the plan dict
         if not conn.send(wire.encode_json_raw(meta, {"plan": plan_json})):
@@ -1173,6 +1316,12 @@ class Broker:
         if rejoining:
             raise Unavailable(
                 f"agent {rejoining[0]} re-registration pending")
+        # past the grace: dead primaries with live replicas re-plan as
+        # failover (virtual) agents instead of dropping out of the answer
+        failover = self._failover_map()
+        ctx.failover = failover
+        if failover:
+            spec = self._spec_with_failover(spec, failover)
 
         def _split():
             with trace.span("plan_split", redispatch=True):
@@ -1268,6 +1417,10 @@ class Broker:
                 "px_hedged_dispatches_total",
                 help_="duplicate dispatches sent for straggling agents "
                       "(first answer wins)")
+            _metrics.counter_inc(
+                "px_hedged_dispatches_by_agent_total",
+                labels={"agent": _metrics.capped_label("agent", agent)},
+                help_="hedged dispatches by (capped) agent name")
         return soonest
 
     def _admit(self, script, func, func_args, default_limit, tenant):
@@ -1394,6 +1547,13 @@ class Broker:
         # under the stale epoch: one redundant miss, never a poisoned hit.
         topo_epoch = self.registry.epoch
         spec = self.registry.cluster_spec()
+        # Failover: dead primaries with live replicas stay IN the plan as
+        # virtual agents — their fragments dispatch to the replica's
+        # connection (serve_for), so the answer keeps covering their shard
+        # instead of silently shrinking to the survivors.
+        failover = self._failover_map()
+        if failover:
+            spec = self._spec_with_failover(spec, failover)
         if not any(a.has_data_store for a in spec.agents):
             e = Unavailable("no live data agents registered")
             # nothing compiled, nothing executed: always safe to retry
@@ -1477,6 +1637,7 @@ class Broker:
             self._req_counter += 1
             req_id = f"q{self._req_counter}"
             ctx = _QueryCtx(set(dp.channels))
+            ctx.failover = failover
             ctx.needed_agents = set(dp.agent_plans)
             ctx.configure_folds(dp, reg)
             self._queries[req_id] = ctx
@@ -1485,8 +1646,13 @@ class Broker:
         # their delta (stale-while-revalidate) and the agents' chunk ack
         # window narrows so producers throttle at the source.  Read at
         # dispatch time (not admit time) so a queue that drained while
-        # this query waited dispatches at full quality.
-        degraded = self.serving.enabled() and self.serving.degraded()
+        # this query waited dispatches at full quality.  Catch-up counts
+        # as degradation too: while a dead shard is served by failover
+        # replicas, views serve stale-while-revalidate and ack windows
+        # narrow — quality sheds, not correctness, while the restarted
+        # shard rehydrates.
+        degraded = self.serving.enabled() and (self.serving.degraded()
+                                               or self.serving.catching_up())
         base_meta = {
             "msg": "execute",
             "analyze": analyze,
@@ -1703,7 +1869,18 @@ class Broker:
                 #: fault-recovery observability per query: re-dispatch
                 #: rounds paid, agents evicted mid-query, hedged duplicate
                 #: dispatches, and chunks discarded at merge — all zero on
-                #: the fault-free path
+                #: the fault-free path.  Row-completeness accounting:
+                #: which primaries answered via a failover replica, and the
+                #: rows each accepted source actually scanned (0 for
+                #: standing-view serves) — the audit trail for "did this
+                #: answer cover every shard".
+                with ctx.lock:
+                    fault["failover"] = dict(ctx.failover_used)
+                fault["rows_scanned"] = {
+                    a: int(s.get("rows_scanned", 0))
+                    for a, s in ctx.agent_stats.items()
+                    if isinstance(s, dict)
+                }
                 stats["fault"] = fault
                 if sink_map is not None:
                     stats["sink_map"] = sink_map

@@ -57,24 +57,30 @@ px.display(df, 'out')
 ]
 
 
-def _mkstore(seed: int, rows: int):
+def _mkdata(seed: int, rows: int) -> dict:
+    rng = np.random.default_rng(seed)
+    svc = np.array([f"svc-{i}" for i in range(6)])
+    return {
+        "time_": np.arange(rows, dtype=np.int64) * 1000,
+        "service": svc[rng.integers(0, len(svc), rows)],
+        "latency": rng.exponential(20.0, rows),
+        "status": rng.choice([200, 404, 500], rows, p=[0.9, 0.05, 0.05]),
+    }
+
+
+def _mkstore(seed: int, rows: int, batch_rows: int = 1 << 13):
     from pixie_tpu.table import TableStore
     from pixie_tpu.types import DataType as DT, Relation
 
-    rng = np.random.default_rng(seed)
     ts = TableStore()
     rel = Relation.of(
         ("time_", DT.TIME64NS), ("service", DT.STRING),
         ("latency", DT.FLOAT64), ("status", DT.INT64),
     )
-    t = ts.create("http_events", rel, batch_rows=1 << 13, max_bytes=1 << 32)
-    svc = np.array([f"svc-{i}" for i in range(6)])
-    t.write({
-        "time_": np.arange(rows, dtype=np.int64) * 1000,
-        "service": svc[rng.integers(0, len(svc), rows)],
-        "latency": rng.exponential(20.0, rows),
-        "status": rng.choice([200, 404, 500], rows, p=[0.9, 0.05, 0.05]),
-    })
+    t = ts.create("http_events", rel, batch_rows=batch_rows,
+                  max_bytes=1 << 32)
+    if rows:
+        t.write(_mkdata(seed, rows))
     return ts
 
 
@@ -243,6 +249,206 @@ def run_chaos(queries: int = 80, rows: int = 200_000, n_agents: int = 3,
     }
 
 
+#: hard-mode batch size: `rows` is rounded UP to a multiple of this so every
+#: acked row seals (and therefore replicates) before the chaos phase — the
+#: precondition for zero-loss recovery when the journal dies WITH the pod
+HARD_BATCH_ROWS = 1 << 12
+
+
+def run_chaos_hard(queries: int = 60, rows: int = 24_576, n_agents: int = 3,
+                   kill_every: int = 7, restart_delay_s: float = 0.8,
+                   retries: int = 6, client_retries: int = 6,
+                   backoff_ms: int = 120, replication: int = 2,
+                   rejoin_grace_s: float = 0.3) -> dict:
+    """The durable-data-plane proof (`chaos_recovery_hard` bench config).
+
+    Same replayed-query contract as `run_chaos`, but the kills are TRUE pod
+    losses: the fault injector's `kill:` rule fires the victim agent's
+    registered handler, which DROPS its in-memory store before the socket
+    RSTs — nothing survives in process state.  Kills alternate between two
+    recovery paths:
+
+      * journal kill — the victim's `PL_DATA_DIR` tree survives (a pod
+        restart on the same node): the restarted agent replays its ingest
+        journal into a fresh store.
+      * wipe kill — the victim's data dir is deleted too (node loss): the
+        restarted agent rehydrates purely by peer fetch of the sealed
+        batches its `PL_REPLICATION` replicas hold.
+
+    While a victim is down past the rejoin grace, its fragments serve from
+    a promoted replica (broker failover), so queries keep answering over
+    the FULL data set — the restart delay deliberately EXCEEDS the grace so
+    every kill exercises the failover path, not just the rejoin hold.
+    Acceptance, held absolutely by `bench.py --check-regressions`:
+
+      * row_loss == 0 — every acknowledged row is present in every agent's
+        store after the final recovery (journal replay + peer fetch).
+      * bit_equal_frac == 1.0 and client_errors == 0 — replayed answers
+        stay bit-identical to the fault-free baseline throughout, whether
+        served by the primary, a failover replica, or a rehydrated store.
+      * recovery_s_max bounded — a restarted agent is registered and
+        serving within the recovery budget.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from pixie_tpu import flags, metrics
+    from pixie_tpu.services import faultinject
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client
+    from pixie_tpu.table import TableStore
+
+    rows = -(-rows // HARD_BATCH_ROWS) * HARD_BATCH_ROWS
+    data_dir = tempfile.mkdtemp(prefix="px-chaos-hard-")
+    saved = {name: flags.get(name) for name in (
+        "PL_QUERY_RETRIES", "PL_RETRY_BACKOFF_MS", "PL_CLIENT_RETRIES",
+        "PL_DATA_DIR", "PL_REPLICATION", "PL_REJOIN_GRACE_S",
+        "PL_JOURNAL_FSYNC")}
+    flags.set_for_testing("PL_QUERY_RETRIES", retries)
+    flags.set_for_testing("PL_RETRY_BACKOFF_MS", backoff_ms)
+    flags.set_for_testing("PL_CLIENT_RETRIES", client_retries)
+    flags.set_for_testing("PL_DATA_DIR", data_dir)
+    flags.set_for_testing("PL_REPLICATION", replication)
+    flags.set_for_testing("PL_REJOIN_GRACE_S", rejoin_grace_s)
+    # batch policy: the in-process kill model loses process state, not the
+    # page cache, so per-record fsync would only slow the bench down
+    flags.set_for_testing("PL_JOURNAL_FSYNC", "batch")
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0).start()
+    agents: dict[str, Agent] = {}
+    expected_rows: dict[str, int] = {}
+    for i in range(n_agents):
+        name = f"pem{i}"
+        ts = _mkstore(i + 1, 0, batch_rows=HARD_BATCH_ROWS)
+        agents[name] = Agent(name, "127.0.0.1", broker.port, store=ts,
+                             heartbeat_s=0.4).start()
+    # ingest AFTER start: journal + replication hooks are attached, so every
+    # written row is acked-durable; rows divide the batch size so the whole
+    # data set seals (and replicates) before any fault fires
+    for i, name in enumerate(sorted(agents)):
+        agents[name].store.table("http_events").write(_mkdata(i + 1, rows))
+        expected_rows[name] = rows
+    for a in agents.values():
+        if a.replication is not None and not a.replication.wait_synced(30.0):
+            raise RuntimeError("replication did not sync before chaos phase")
+    client = Client("127.0.0.1", broker.port, timeout_s=90.0)
+
+    restarters: list[threading.Thread] = []
+    recovery_s: list[float] = []
+    decision_log: list[tuple] = []
+
+    def kill_and_restart(victim: str, wipe: bool):
+        """Arm a one-shot `kill:` rule for the victim's broker link — its
+        next outbound frame drops the store and RSTs — then restart it
+        with a FRESH store after the delay (journal replay + peer fetch
+        do the recovery; nothing is preserved in process state)."""
+        t_kill = time.monotonic()
+        inj = faultinject.install(f"kill:agent:{victim}@send=1")
+
+        def restart():
+            old = agents[victim]
+            if not old.pod_killed.wait(timeout=10.0):
+                return  # the rule never fired (stopped bench)
+            decision_log.extend(inj.log)
+            if wipe:
+                shutil.rmtree(os.path.join(data_dir, victim),
+                              ignore_errors=True)
+            time.sleep(restart_delay_s)
+            agents[victim] = Agent(victim, "127.0.0.1", broker.port,
+                                   store=TableStore(),
+                                   heartbeat_s=0.4).start()
+            recovery_s.append(time.monotonic() - t_kill)
+
+        th = threading.Thread(target=restart, daemon=True)
+        th.start()
+        restarters.append(th)
+
+    try:
+        baseline: list[bytes] = []
+        base_lat: list[float] = []
+        for i in range(queries):
+            t0 = time.perf_counter()
+            res = client.execute_script(SCRIPTS[i % len(SCRIPTS)])
+            base_lat.append(time.perf_counter() - t0)
+            baseline.append(canonical_bytes(res))
+
+        chaos_lat: list[float] = []
+        ok = bit_equal = errors = kills = wipes = 0
+        victims = sorted(agents)
+        for i in range(queries):
+            if kill_every > 0 and i % kill_every == kill_every - 1:
+                # serialize recoveries: the next kill waits for the prior
+                # victim to finish rehydrating (two simultaneous losses
+                # would exceed PL_REPLICATION=2's tolerance by design)
+                for th in restarters:
+                    th.join(timeout=30.0)
+                wipe = kills % 2 == 1
+                wipes += int(wipe)
+                kills += 1
+                kill_and_restart(victims[kills % len(victims)], wipe)
+            t0 = time.perf_counter()
+            try:
+                res = client.execute_script(SCRIPTS[i % len(SCRIPTS)])
+                chaos_lat.append(time.perf_counter() - t0)
+                ok += 1
+                if canonical_bytes(res) == baseline[i]:
+                    bit_equal += 1
+            except Exception:
+                errors += 1
+        for th in restarters:
+            th.join(timeout=30.0)
+        # the zero-loss audit: after the last recovery every agent holds
+        # every row it ever acked (journal replay and/or peer fetch)
+        row_loss = 0
+        for name, a in sorted(agents.items()):
+            have = (a.store.table("http_events").stats()["rows_written"]
+                    if a.store.has("http_events") else 0)
+            row_loss += max(0, expected_rows[name] - have)
+        repl_rows = metrics.counter_value("px_repl_rehydrated_rows_total")
+        journal_rows = metrics.counter_value("px_journal_replayed_rows_total")
+        failover_serves = metrics.counter_value("px_failover_serves_total")
+    finally:
+        faultinject.uninstall()
+        client.close()
+        for a in agents.values():
+            try:
+                a.stop()
+            except Exception:
+                pass
+        broker.stop()
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+    base_p99 = _pct(base_lat, 0.99) * 1000
+    chaos_p99 = _pct(chaos_lat, 0.99) * 1000
+    return {
+        "rows": queries,  # the --check-regressions shape key
+        "queries": queries,
+        "ingest_rows": rows,
+        "n_agents": n_agents,
+        "replication": replication,
+        "kills": kills,
+        "wipe_kills": wipes,
+        "row_loss": row_loss,
+        "recovery_rate": round(ok / max(queries, 1), 4),
+        "bit_equal_frac": round(bit_equal / max(queries, 1), 4),
+        "client_errors": errors,
+        "recovery_s_max": round(max(recovery_s, default=0.0), 2),
+        "recovery_s_mean": round(sum(recovery_s)
+                                 / max(len(recovery_s), 1), 2),
+        "baseline_p99_ms": round(base_p99, 1),
+        "chaos_p99_ms": round(chaos_p99, 1),
+        "added_p99_ms": round(max(chaos_p99 - base_p99, 0.0), 1),
+        "journal_replayed_rows": round(journal_rows, 1),
+        "repl_rehydrated_rows": round(repl_rows, 1),
+        "failover_serves": round(failover_serves, 1),
+        "kill_decisions": len(decision_log),
+    }
+
+
 def main(argv=None):  # pragma: no cover — exercised via bench.py
     import argparse
     import json
@@ -252,10 +458,13 @@ def main(argv=None):  # pragma: no cover — exercised via bench.py
     ap.add_argument("--rows", type=int, default=200_000)
     ap.add_argument("--agents", type=int, default=3)
     ap.add_argument("--kill-every", type=int, default=7)
+    ap.add_argument("--hard", action="store_true",
+                    help="run the durable-data-plane variant (store+journal "
+                         "destruction, replication failover, rehydration)")
     args = ap.parse_args(argv)
-    print(json.dumps(run_chaos(queries=args.queries, rows=args.rows,
-                               n_agents=args.agents,
-                               kill_every=args.kill_every),
+    fn = run_chaos_hard if args.hard else run_chaos
+    print(json.dumps(fn(queries=args.queries, rows=args.rows,
+                        n_agents=args.agents, kill_every=args.kill_every),
                      separators=(",", ":")))
 
 

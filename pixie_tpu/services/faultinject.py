@@ -13,6 +13,13 @@ Plan grammar (`PL_FAULT_PLAN`, rules separated by `;`):
     seed=42                              # jitter RNG seed (default 0)
     crash:agent:pem2@send=5              # close the conn hard before its
                                          #   5th outbound frame
+    kill:agent:pem2@send=5               # TRUE pod loss: fire the label's
+                                         #   registered kill handler (the
+                                         #   agent DROPS its in-memory
+                                         #   store) then RST the socket —
+                                         #   recovery must come from the
+                                         #   journal + replica peers, never
+                                         #   from preserved process state
     reset:agent:pem2@recv=3              # RST (SO_LINGER 0) before the 3rd
                                          #   inbound frame is delivered
     drop:agent:pem1@send=2               # swallow one frame silently
@@ -52,7 +59,7 @@ flags.define_str(
     "crash/reset/drop/delay at frame N, slow with seeded jitter); empty "
     "disables injection entirely")
 
-ACTIONS = ("crash", "reset", "drop", "delay", "slow")
+ACTIONS = ("crash", "reset", "drop", "delay", "slow", "kill")
 
 
 @dataclasses.dataclass
@@ -111,7 +118,7 @@ def parse_plan(spec: str) -> tuple[int, list[Rule]]:
         if action == "slow" and frame is not None:
             raise InvalidArgument("fault plan: slow rules apply to every "
                                   "frame (use delay for one frame)")
-        if action in ("crash", "reset", "drop") and frame is None:
+        if action in ("crash", "reset", "drop", "kill") and frame is None:
             raise InvalidArgument(f"fault plan: {action} needs @send=N/@recv=N")
         if action == "delay" and frame is None:
             raise InvalidArgument("fault plan: delay needs @send=N/@recv=N")
@@ -190,6 +197,43 @@ class FaultInjector:
 #: the transport's per-frame cost to one attribute load
 _active: Optional[FaultInjector] = None
 _install_lock = threading.Lock()
+
+#: label → pod-kill handler (agents register their broker-link label).
+#: A `kill:` decision fires the handler BEFORE the RST so the store is
+#: gone by the time the broker sees the eviction — exactly a pod death's
+#: ordering.  Exact-label match: the handler registry is a service-side
+#: contract, not a chaos-plan pattern (plans still match by fnmatch).
+_kill_handlers: dict[str, object] = {}
+_kill_lock = threading.Lock()
+
+
+def register_kill_handler(label: str, fn) -> None:
+    with _kill_lock:
+        _kill_handlers[label] = fn
+
+
+def unregister_kill_handler(label: str, fn=None) -> None:
+    """Remove the label's handler.  Pass `fn` to remove ONLY if that exact
+    handler is still registered — a stopped old Agent instance must not pop
+    the handler its restarted successor registered under the same label."""
+    with _kill_lock:
+        if fn is None or _kill_handlers.get(label) == fn:
+            _kill_handlers.pop(label, None)
+
+
+def fire_kill(label: str) -> bool:
+    """Invoke the kill handler for `label` (transport calls this on a
+    `kill` decision).  Returns whether a handler ran; handler errors are
+    swallowed — the connection dies regardless, as in a real pod loss."""
+    with _kill_lock:
+        fn = _kill_handlers.get(label)
+    if fn is None:
+        return False
+    try:
+        fn()
+    except Exception:
+        pass
+    return True
 
 
 def install(spec: Optional[str] = None) -> Optional[FaultInjector]:

@@ -22,6 +22,17 @@ class KVStore:
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
+            if path != ":memory:":
+                # Crash safety: the KV now backs the replication shard map
+                # and the agent registry, so a broker killed mid-write must
+                # reopen to a consistent store.  WAL keeps readers unblocked
+                # and makes commits an fsynced append; synchronous=FULL
+                # makes every commit durable through power loss, not just
+                # process death; busy_timeout bounds writer contention from
+                # a standby broker sharing the file instead of failing cas.
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA synchronous=FULL")
+                self._conn.execute("PRAGMA busy_timeout=5000")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v BLOB)"
             )

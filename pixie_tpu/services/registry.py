@@ -36,6 +36,10 @@ class AgentRecord:
     #: The broker's rejoin grace window measures from this: a JUST-dead
     #: agent is likely a restarting pod, not a removed one.
     died_at: float = 0.0
+    #: (host, port) of the agent's replication peer server (None when the
+    #: agent runs without PL_REPLICATION); persisted so a rehydrating peer
+    #: can find its replicas across broker restarts
+    repl_addr: Optional[tuple] = None
 
 
 class AgentRegistry:
@@ -65,12 +69,15 @@ class AgentRegistry:
                 n_devices=d.get("n_devices"),
                 last_heartbeat=0.0,
                 alive=False,
+                repl_addr=(tuple(d["repl_addr"])
+                           if d.get("repl_addr") else None),
             )
             self._agents[rec.name] = rec
             self._next_asid = max(self._next_asid, rec.asid + 1)
 
     # ---------------------------------------------------------------- mutation
-    def register(self, name: str, schemas: dict, n_devices: Optional[int] = None) -> int:
+    def register(self, name: str, schemas: dict, n_devices: Optional[int] = None,
+                 repl_addr: Optional[tuple] = None) -> int:
         """(Re-)register an agent; returns its ASID."""
         now = time.monotonic()
         with self._lock:
@@ -86,6 +93,7 @@ class AgentRegistry:
                 rec.last_heartbeat = now
                 rec.alive = True
             rec.incarnation += 1
+            rec.repl_addr = tuple(repl_addr) if repl_addr else None
             self.kv.set_json(
                 f"agent/{name}",
                 {
@@ -93,8 +101,10 @@ class AgentRegistry:
                     "asid": rec.asid,
                     "schemas": {t: r.to_dict() for t, r in schemas.items()},
                     "n_devices": n_devices,
+                    "repl_addr": list(rec.repl_addr) if rec.repl_addr else None,
                 },
             )
+            self._update_shard_map_locked()
             return rec.asid
 
     def heartbeat(self, name: str) -> bool:
@@ -114,10 +124,12 @@ class AgentRegistry:
         with self._lock:
             rec = self._agents.get(name)
             if rec is not None:
-                if rec.alive:
+                was_alive = rec.alive
+                rec.alive = False
+                if was_alive:
                     self.epoch += 1
                     rec.died_at = time.monotonic()
-                rec.alive = False
+                    self._update_shard_map_locked()
 
     def expire(self) -> list[str]:
         """Mark agents whose heartbeats lapsed as dead; returns newly-dead."""
@@ -131,7 +143,69 @@ class AgentRegistry:
                     out.append(rec.name)
             if out:
                 self.epoch += 1
+                self._update_shard_map_locked()
         return out
+
+    # --------------------------------------------------------------- shard map
+    def _update_shard_map_locked(self) -> None:
+        """Recompute + persist the primary→replicas shard map on every
+        liveness change (join/evict).  Replicas are the next
+        PL_REPLICATION-1 LIVE agents after the primary in sorted ring
+        order; dead primaries KEEP an entry (their replicas are exactly
+        what failover and rehydration need to find).  No-op with
+        replication disabled — no KV writes, bit-identical legacy paths."""
+        from pixie_tpu import flags as _flags
+
+        try:
+            k = int(_flags.get("PL_REPLICATION"))
+        except Exception:  # services.replication not imported in this process
+            return
+        if k <= 1:
+            return
+        live = sorted(r.name for r in self._agents.values() if r.alive)
+        out: dict[str, list] = {}
+        import bisect
+
+        for name in sorted(self._agents):
+            ring = [a for a in live if a != name]
+            if not ring:
+                out[name] = []
+                continue
+            pos = bisect.bisect_left(ring, name)
+            out[name] = [ring[(pos + i) % len(ring)]
+                         for i in range(min(k - 1, len(ring)))]
+        self.kv.set_json("shardmap/current", {"k": k, "map": out})
+
+    def shard_map(self) -> dict:
+        """The persisted primary→replicas map ({} when replication is off)."""
+        return (self.kv.get_json("shardmap/current") or {}).get("map", {})
+
+    def peer_addrs(self) -> dict[str, list]:
+        """Replication peer addresses of LIVE agents (dead peers are not
+        dialable; a rehydrating agent re-registers with a fresh port)."""
+        with self._lock:
+            return {r.name: list(r.repl_addr)
+                    for r in self._agents.values()
+                    if r.alive and r.repl_addr}
+
+    def record(self, name: str) -> Optional[AgentRecord]:
+        with self._lock:
+            return self._agents.get(name)
+
+    def deregister(self, name: str) -> bool:
+        """Permanently remove an agent (operator decommission).  Without
+        this a retired node's durable record keeps it in the shard map as
+        a failover primary forever — every plan carries its virtual shard
+        and the serving front never leaves catch-up.  Returns whether the
+        record existed."""
+        with self._lock:
+            rec = self._agents.pop(name, None)
+            if rec is None:
+                return False
+            self.epoch += 1
+            self.kv.delete(f"agent/{name}")
+            self._update_shard_map_locked()
+            return True
 
     # ------------------------------------------------------------------- views
     def incarnation(self, name: str) -> int:

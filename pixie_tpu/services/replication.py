@@ -1,0 +1,564 @@
+"""Sealed-batch replication: each shard's sealed batches live on peers too.
+
+The journal (table/journal.py) makes acked rows survive a RESTART; this
+module makes them survive losing the pod's disk entirely.  Every agent runs
+a small framed-TCP peer server; when a table seals a batch, the primary
+ships it (values, not dictionary codes — deterministic re-encode on the far
+side) to the `PL_REPLICATION - 1` replica peers the shard map assigns.  The
+map itself lives in the control KV and is maintained by the registry on
+join/evict (services/registry.py); the broker pushes map + peer addresses
+to agents on every topology change.
+
+Replicas keep the batches in memory, serving three consumers:
+
+  * failover — the broker re-plans a dead primary's fragments onto a live
+    replica (`serve_for` dispatch); the replica materializes a takeover
+    TableStore from the primary's batches and executes the fragment over it.
+  * rehydration — a restarting primary fetches the sealed batches its
+    journal no longer covers (wiped/pruned segments) before registering.
+  * audit — manifests expose per-primary coverage for completeness checks.
+
+Peer protocol (wire frames on the peer port):
+
+  repl_batch    host_batch frame, meta {msg, primary, table, relation,
+                batch_rows, max_bytes, row_id_start, n, seq} → repl_ack
+  repl_manifest json {primary} → repl_manifest_ack {tables: {name:
+                {relation, batch_rows, max_bytes, ranges: [[start, n]...]}}}
+  repl_get      json {primary, table, row_id_start} → one repl_batch reply
+
+`PL_REPLICATION=1` (the default) disables everything — no peer server, no
+hooks, bit-identical to the seed behavior.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Optional
+
+from pixie_tpu import flags, metrics
+from pixie_tpu.services import wire
+from pixie_tpu.services.transport import Connection, Server, dial
+from pixie_tpu.status import Unavailable
+from pixie_tpu.table.journal import decode_columns, encode_columns
+from pixie_tpu.types import Relation
+
+flags.define_int(
+    "PL_REPLICATION", 1,
+    "copies of every sealed batch across the agent set (including the "
+    "primary); 1 disables replication entirely — the seed single-copy "
+    "behavior, bit-identical")
+
+
+def enabled() -> bool:
+    return int(flags.get("PL_REPLICATION")) > 1
+
+
+def encode_sealed(table, batch, row_id_start: int, primary: str,
+                  seq: int) -> bytes:
+    """One sealed RowBatch → a repl_batch frame.  Dictionary codes decode
+    to values here; the receiver re-encodes into its own code space."""
+    nv = batch.num_valid
+    data = {}
+    for c in table.relation:
+        arr = batch.columns[c.name][:nv]
+        if c.name in table.dictionaries:
+            data[c.name] = table.dictionaries[c.name].decode(arr)
+        else:
+            data[c.name] = arr
+    return encode_columns(table.relation, data, {
+        "msg": "repl_batch", "primary": primary, "table": table.name,
+        "relation": table.relation.to_dict(), "batch_rows": table.batch_rows,
+        "max_bytes": table.max_bytes, "row_id_start": int(row_id_start),
+        "n": int(nv), "seq": int(seq),
+    })
+
+
+class ReplicaStore:
+    """Sealed batches held FOR other primaries, keyed (primary, table,
+    row_id_start); materializes takeover TableStores on demand."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: primary -> table -> {"relation","batch_rows","max_bytes",
+        #:                      "batches": {row_id_start: (n, {col: vals})}}
+        self._data: dict[str, dict[str, dict]] = {}
+        self._version: dict[str, int] = {}
+        #: primary -> (version, TableStore) takeover materialization cache
+        self._stores: dict[str, tuple[int, object]] = {}
+
+    def put(self, meta: dict, data: dict) -> None:
+        primary = str(meta["primary"])
+        with self._lock:
+            tabs = self._data.setdefault(primary, {})
+            t = tabs.get(meta["table"])
+            if t is None:
+                t = tabs[meta["table"]] = {
+                    "relation": meta["relation"],
+                    "batch_rows": int(meta["batch_rows"]),
+                    "max_bytes": int(meta["max_bytes"]),
+                    "batches": {},
+                }
+            t["batches"][int(meta["row_id_start"])] = (int(meta["n"]), data)
+            self._version[primary] = self._version.get(primary, 0) + 1
+            stale = self._stores.pop(primary, None)
+        self._drop_resident(stale)
+        metrics.counter_inc(
+            "px_repl_batches_received_total",
+            help_="sealed batches accepted from primary peers")
+
+    @staticmethod
+    def _drop_resident(stale) -> None:
+        """A dropped takeover store's tables may have device-pinned resident
+        entries; free them now (pinned-tier invalidation on shard-map /
+        replica-content change)."""
+        if stale is None:
+            return
+        try:
+            from pixie_tpu.engine import resident
+
+            for name in stale[1].names():
+                resident.drop_table(stale[1].table(name).uid)
+        except Exception:  # engine layer absent must not break replication
+            pass
+
+    def primaries(self) -> list[str]:
+        with self._lock:
+            return sorted(self._data)
+
+    def manifest(self, primary: str) -> dict:
+        with self._lock:
+            tabs = self._data.get(primary, {})
+            return {
+                name: {
+                    "relation": t["relation"],
+                    "batch_rows": t["batch_rows"],
+                    "max_bytes": t["max_bytes"],
+                    "ranges": sorted(
+                        [s, n] for s, (n, _) in t["batches"].items()),
+                }
+                for name, t in tabs.items()
+            }
+
+    def get_batch(self, primary: str, table: str, row_id_start: int):
+        with self._lock:
+            t = self._data.get(primary, {}).get(table)
+            if t is None:
+                return None
+            hit = t["batches"].get(int(row_id_start))
+            if hit is None:
+                return None
+            n, data = hit
+            return {"relation": t["relation"], "batch_rows": t["batch_rows"],
+                    "max_bytes": t["max_bytes"], "n": n, "data": data}
+
+    def drop_primaries(self, keep: set) -> None:
+        """Shard-map change: free replica state for primaries this node no
+        longer backs (and their takeover materializations)."""
+        with self._lock:
+            gone = [p for p in self._data if p not in keep]
+            stale = []
+            for p in gone:
+                self._data.pop(p, None)
+                self._version.pop(p, None)
+                s = self._stores.pop(p, None)
+                if s is not None:
+                    stale.append(s)
+        for s in stale:
+            self._drop_resident(s)
+
+    def takeover_store(self, primary: str):
+        """A TableStore materialized from the primary's replicated sealed
+        batches (values re-encoded locally; batch_rows preserved, so sealing
+        reproduces the primary's batch layout).  Cached per content version."""
+        from pixie_tpu.table.table import TableStore
+
+        with self._lock:
+            ver = self._version.get(primary, 0)
+            hit = self._stores.get(primary)
+            if hit is not None and hit[0] == ver:
+                return hit[1]
+            tabs = {
+                name: (t["relation"], t["batch_rows"], t["max_bytes"],
+                       sorted(t["batches"].items()))
+                for name, t in self._data.get(primary, {}).items()
+            }
+        store = TableStore()
+        for name, (rel, batch_rows, max_bytes, batches) in tabs.items():
+            tb = store.create(name, Relation.from_dict(rel),
+                              batch_rows=batch_rows, max_bytes=max_bytes)
+            expected = batches[0][0] if batches else 0
+            for start, (n, data) in batches:
+                if start != expected:
+                    # a HOLE (a replication send that never arrived):
+                    # writing past it would place later rows at wrong row
+                    # ids — serve the contiguous prefix and count the gap
+                    # loudly instead of answering with mis-positioned rows
+                    metrics.counter_inc(
+                        "px_repl_takeover_holes_total",
+                        help_="takeover materializations truncated at a "
+                              "missing replicated batch")
+                    break
+                tb.write(data)
+                expected = start + n
+        with self._lock:
+            # keep whichever materialization is newest; a racing put()
+            # already invalidated ours if the content moved on
+            if self._version.get(primary, 0) == ver:
+                self._stores[primary] = (ver, store)
+        return store
+
+
+class PeerClient:
+    """One request/reply client connection to a peer's replication port."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        self.timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._pending: dict[str, list] = {}
+        self.conn = dial(host, port, on_frame=self._on_frame)
+        self.conn.label = "repl-client"
+
+    def _on_frame(self, conn: Connection, frame: bytes) -> None:
+        kind, payload = wire.decode_frame(frame)
+        meta = payload if kind == "json" else payload.wire_meta
+        rid = meta.get("req_id")
+        with self._lock:
+            slot = self._pending.get(rid)
+        if slot is not None:
+            slot[1] = (kind, payload)
+            slot[0].set()
+
+    def request(self, meta: dict):
+        rid = meta.setdefault("req_id", uuid.uuid4().hex)
+        slot = [threading.Event(), None]
+        with self._lock:
+            self._pending[rid] = slot
+        try:
+            if not self.conn.send(wire.encode_json(meta)):
+                raise Unavailable("replication peer not reachable")
+            if not slot[0].wait(self.timeout_s):
+                raise Unavailable(f"replication peer timed out on "
+                                  f"{meta.get('msg')}")
+            return slot[1]
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+    def close(self) -> None:
+        self.conn.close()
+
+
+class ReplicationManager:
+    """Per-agent replication runtime: peer server + sealed-batch fan-out."""
+
+    def __init__(self, name: str, store):
+        self.name = name
+        self.store = store
+        self.replicas = ReplicaStore()
+        self._server = Server("127.0.0.1", 0, self._on_peer_frame)
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._peers: dict[str, tuple[str, int]] = {}
+        self._targets: list[str] = []
+        self._conns: dict[str, Connection] = {}
+        self._seq = 0
+        #: target -> highest repl_ack seq seen (wait_synced blocks on these)
+        self._acked: dict[str, int] = {}
+        self._sent: dict[str, int] = {}
+        self._synced = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._sender = threading.Thread(target=self._send_loop, daemon=True,
+                                        name=f"pixie-repl-{name}")
+
+    # --------------------------------------------------------------- lifecycle
+    def start(self) -> "ReplicationManager":
+        self._server.start()
+        self._sender.start()
+        self._attach(self.store)
+        return self
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def addr(self) -> tuple[str, int]:
+        return ("127.0.0.1", self.port)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._q.put(None)
+        self._server.stop()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            c.close()
+        self._detach(self.store)
+
+    def _attach(self, store) -> None:
+        from pixie_tpu.table.journal import non_durable_tables
+        from pixie_tpu.table.table import Table
+
+        def hook(table):
+            if (isinstance(table, Table)
+                    and table.name not in non_durable_tables()):
+                table.on_seal = self._on_seal
+
+        for name in store.names():
+            hook(store._tables.get(name))
+        store.add_observer(hook)
+
+    def _detach(self, store) -> None:
+        from pixie_tpu.table.table import Table
+
+        for name in store.names():
+            t = store._tables.get(name)
+            if isinstance(t, Table) and t.on_seal == self._on_seal:
+                t.on_seal = None
+
+    # ---------------------------------------------------------------- topology
+    def on_shard_map(self, shard_map: dict, peers: dict) -> None:
+        """Broker-pushed topology: who this node replicates TO, where every
+        peer's replication port lives, and which primaries it still backs.
+        A NEW replica target gets a full backfill of already-sealed batches
+        — batches sealed before it joined must reach it too, or its
+        takeover coverage silently starts at its join time."""
+        backs = {p for p, reps in shard_map.items()
+                 if self.name in (reps or []) and p != self.name}
+        with self._lock:
+            old = set(self._targets)
+            self._targets = [r for r in shard_map.get(self.name, [])
+                             if r != self.name]
+            added = [r for r in self._targets if r not in old]
+            self._peers = {n: (str(h), int(p))
+                           for n, (h, p) in (peers or {}).items()
+                           if n != self.name}
+        self.replicas.drop_primaries(backs)
+        for target in added:
+            self._backfill(target)
+
+    def _backfill(self, target: str) -> None:
+        """Enqueue every already-sealed batch for one new replica target.
+        Receivers keyed by (primary, table, row_id_start) make duplicate
+        delivery (backfill racing a live seal) idempotent."""
+        from pixie_tpu.table.journal import non_durable_tables
+        from pixie_tpu.table.table import Table
+
+        for name in self.store.names():
+            table = self.store._tables.get(name)
+            if not isinstance(table, Table) or name in non_durable_tables():
+                continue
+            for rb, rid, gen in table.cursor(include_hot=False):
+                if gen is None:
+                    continue
+                self._enqueue(table, rb, rid, [target])
+
+    def peer_addr(self, name: str) -> Optional[tuple[str, int]]:
+        with self._lock:
+            return self._peers.get(name)
+
+    # ---------------------------------------------------------------- outbound
+    def _on_seal(self, table, sealed: list) -> None:
+        with self._lock:
+            targets = list(self._targets)
+        if not targets:
+            return
+        for sb in sealed:
+            self._enqueue(table, sb.batch, sb.row_id_start, targets)
+
+    def _enqueue(self, table, batch, row_id_start: int,
+                 targets: list) -> None:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        frame = encode_sealed(table, batch, row_id_start, self.name, seq)
+        for t in targets:
+            with self._lock:
+                self._sent[t] = max(self._sent.get(t, 0), seq)
+            self._q.put((t, seq, frame, 0))
+
+    #: re-dial + re-send attempts per batch before a send failure becomes a
+    #: hole (holes are survivable — takeover serves the contiguous prefix
+    #: and a rehydrating primary falls back to its journal — but cheap to
+    #: avoid for the common transient-dial case)
+    SEND_RETRIES = 3
+
+    def _send_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._q.get()
+            if item is None:
+                return
+            target, seq, frame, tries = item
+            conn = self._peer_conn(target)
+            if conn is not None and conn.send(frame):
+                continue
+            # the cached conn may be a dead socket: drop it so the retry
+            # redials, and requeue with a bounded budget
+            with self._lock:
+                stale = self._conns.pop(target, None)
+            if stale is not None:
+                stale.close()
+            if tries < self.SEND_RETRIES and not self._stop.is_set():
+                time.sleep(0.05 * (tries + 1))
+                self._q.put((target, seq, frame, tries + 1))
+                continue
+            metrics.counter_inc(
+                "px_repl_send_failures_total",
+                help_="sealed-batch replication sends that failed after "
+                      "retries (the replica holds a hole until backfill)")
+            with self._synced:
+                self._acked[target] = max(self._acked.get(target, 0), seq)
+                self._synced.notify_all()
+
+    def _peer_conn(self, name: str) -> Optional[Connection]:
+        with self._lock:
+            conn = self._conns.get(name)
+            addr = self._peers.get(name)
+        if conn is not None and not conn.closed:
+            return conn
+        if addr is None:
+            return None
+        try:
+            conn = dial(addr[0], addr[1], on_frame=self._on_ack_frame)
+            conn.label = f"repl:{self.name}"
+        except OSError:
+            return None
+        with self._lock:
+            self._conns[name] = conn
+        return conn
+
+    def _on_ack_frame(self, conn: Connection, frame: bytes) -> None:
+        kind, payload = wire.decode_frame(frame)
+        if kind != "json" or payload.get("msg") != "repl_ack":
+            return
+        sender = str(payload.get("replica") or "")
+        with self._synced:
+            self._acked[sender] = max(self._acked.get(sender, 0),
+                                      int(payload.get("seq") or 0))
+            self._synced.notify_all()
+
+    def wait_synced(self, timeout_s: float = 10.0) -> bool:
+        """Block until every target acked every enqueued batch (benches and
+        tests use this to bound the replication race before injecting
+        faults; production sends stay fire-and-forget)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        with self._synced:
+            while any(self._acked.get(t, 0) < s
+                      for t, s in self._sent.items()):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._synced.wait(timeout=min(left, 0.2))
+        return True
+
+    # ----------------------------------------------------------------- inbound
+    def _on_peer_frame(self, conn: Connection, frame: bytes) -> None:
+        kind, payload = wire.decode_frame(frame)
+        if kind == "host_batch":
+            meta = payload.wire_meta
+            if meta.get("msg") != "repl_batch":
+                return
+            self.replicas.put(meta, decode_columns(payload))
+            conn.send(wire.encode_json({
+                "msg": "repl_ack", "seq": int(meta.get("seq") or 0),
+                "replica": self.name}))
+            return
+        if kind != "json":
+            return
+        msg = payload.get("msg")
+        if msg == "repl_manifest":
+            conn.send(wire.encode_json({
+                "msg": "repl_manifest_ack", "req_id": payload.get("req_id"),
+                "tables": self.replicas.manifest(str(payload.get("primary"))),
+            }))
+        elif msg == "repl_get":
+            hit = self.replicas.get_batch(
+                str(payload.get("primary")), str(payload.get("table")),
+                int(payload.get("row_id_start") or 0))
+            if hit is None:
+                conn.send(wire.encode_json({
+                    "msg": "error", "req_id": payload.get("req_id"),
+                    "error": "replica batch not found"}))
+                return
+            rel = Relation.from_dict(hit["relation"])
+            conn.send(encode_columns(rel, hit["data"], {
+                "msg": "repl_batch", "req_id": payload.get("req_id"),
+                "primary": payload.get("primary"),
+                "table": payload.get("table"), "relation": hit["relation"],
+                "batch_rows": hit["batch_rows"],
+                "max_bytes": hit["max_bytes"],
+                "row_id_start": int(payload.get("row_id_start") or 0),
+                "n": hit["n"], "seq": 0,
+            }))
+
+    # ------------------------------------------------------------- rehydration
+    def takeover_store(self, primary: str):
+        metrics.counter_inc(
+            "px_failover_serves_total",
+            help_="fragments served from replicated batches for a dead "
+                  "primary (takeover dispatch)")
+        return self.replicas.takeover_store(primary)
+
+    def fetch_missing(self, store, holders: list[str],
+                      timeout_s: float = 10.0) -> dict:
+        """Pull this node's OWN missing sealed batches from the peers that
+        back it (`holders` = the shard map's replica list for this node).
+        Journal replay runs first; this covers journal segments lost with
+        the pod.  Batches overlapping the local row watermark are sliced so
+        the store stays contiguous and seals reproduce the primary layout."""
+        from pixie_tpu.table.table import Table
+
+        stats = {"batches": 0, "rows": 0, "tables": 0, "holes": 0}
+        for holder in holders:
+            addr = self.peer_addr(holder)
+            if addr is None:
+                continue
+            try:
+                client = PeerClient(*addr, timeout_s=timeout_s)
+            except OSError:
+                continue
+            try:
+                kind, reply = client.request(
+                    {"msg": "repl_manifest", "primary": self.name})
+                tables = (reply.get("tables") or {}) if kind == "json" else {}
+                for tname, m in sorted(tables.items()):
+                    if not store.has(tname):
+                        store.create(tname, Relation.from_dict(m["relation"]),
+                                     batch_rows=int(m["batch_rows"]),
+                                     max_bytes=int(m["max_bytes"]))
+                        stats["tables"] += 1
+                    table = store._tables.get(tname)
+                    if not isinstance(table, Table):
+                        continue
+                    for start, n in m.get("ranges") or []:
+                        have = table.last_row_id()
+                        if start + n <= have:
+                            continue  # journal replay already covers it
+                        if start > have:
+                            stats["holes"] += 1
+                            break  # applying past a hole fabricates ids
+                        k2, batch = client.request({
+                            "msg": "repl_get", "primary": self.name,
+                            "table": tname, "row_id_start": int(start)})
+                        if k2 != "host_batch":
+                            break
+                        data = decode_columns(batch)
+                        off = have - int(start)
+                        if off:
+                            data = {c: v[off:] for c, v in data.items()}
+                        table.write(data)
+                        stats["batches"] += 1
+                        stats["rows"] += int(n) - off
+            except Unavailable:
+                continue
+            finally:
+                client.close()
+        if stats["rows"]:
+            metrics.counter_inc(
+                "px_repl_rehydrated_rows_total", float(stats["rows"]),
+                help_="rows restored from replica peers during rehydration")
+        return stats

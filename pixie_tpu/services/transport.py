@@ -101,6 +101,13 @@ class Connection:
         if d.action == "reset":
             self.abort()
             return "closed"
+        if d.action == "kill":
+            # true pod loss: the label's registered handler drops the
+            # owner's in-memory state FIRST, then the socket RSTs — the
+            # peer observes exactly what a reaped pod leaves behind
+            faultinject.fire_kill(self.label)
+            self.abort()
+            return "closed"
         self.close()  # crash: the peer sees a dead socket mid-stream
         return "closed"
 

@@ -117,8 +117,12 @@ class ServingFront:
         #: peak inflight since start (the load harness asserts boundedness)
         self.peak_queued = 0
         self.peak_inflight = 0
-        self._labeled: set[str] = set()
         self._gauges = False
+        #: primaries currently served by failover replicas (set by the
+        #: broker on shard-map pushes): >0 means part of the data plane is
+        #: catching up and dispatch degrades (stale-while-revalidate views,
+        #: narrowed ack windows) until the restarted shard re-registers
+        self.catchup_shards = 0
 
     #: idle tenant states above this count are pruned (a flood of distinct
     #: tenant ids must not grow scheduler memory without bound; a pruned
@@ -130,16 +134,23 @@ class ServingFront:
     #: distinct tenant ids that get their OWN metric label series; ids past
     #: the cap share the "__other__" label — counter series in the metrics
     #: registry are immortal, so an id flood must not grow them per tenant
-    #: the way the (pruned) scheduler states don't
-    MAX_LABELED_TENANTS = 256
+    #: the way the (pruned) scheduler states don't.  The cap now lives in
+    #: metrics.capped_label, shared with the broker's per-agent series.
+    MAX_LABELED_TENANTS = metrics.MAX_LABEL_IDS
 
     def _label(self, tenant: str) -> str:
-        if tenant in self._labeled:
-            return tenant
-        if len(self._labeled) < self.MAX_LABELED_TENANTS:
-            self._labeled.add(tenant)
-            return tenant
-        return "__other__"
+        return metrics.capped_label("tenant", tenant,
+                                    cap=self.MAX_LABELED_TENANTS)
+
+    def set_catchup(self, shards: int) -> None:
+        self.catchup_shards = int(shards)
+        metrics.gauge_set(
+            "px_serving_catchup_shards", float(shards),
+            help_="dead primaries currently served by failover replicas "
+                  "(dispatch degrades until they rehydrate and re-register)")
+
+    def catching_up(self) -> bool:
+        return self.catchup_shards > 0
 
     # ------------------------------------------------------------------ state
     def _state(self, tenant: str) -> _TenantState:

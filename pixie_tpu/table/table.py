@@ -86,6 +86,14 @@ class Table:
             c.name: Dictionary() for c in relation if is_dict_encoded(c.data_type)
         }
         self._lock = threading.Lock()
+        #: durable ingest journal (table.journal.TableJournal) — when set,
+        #: every acknowledged write appends one CRC-framed record BEFORE
+        #: returning, so a restarted process replays acked rows back
+        self.journal = None
+        #: seal observer (replication): called OUTSIDE the lock with the
+        #: newly sealed batches of one write — services/replication.py ships
+        #: them to this shard's replica peers
+        self.on_seal = None
         self._sealed: list[_SealedBatch] = []
         self._hot: dict[str, list[np.ndarray]] = {c.name: [] for c in relation}
         self._hot_rows = 0
@@ -145,6 +153,7 @@ class Table:
         if not n:
             return 0
         with self._lock:
+            gen0 = self._next_gen
             for k, v in cols.items():
                 self._hot[k].append(v)
             self._hot_rows += n
@@ -152,6 +161,29 @@ class Table:
             if self._hot_rows >= self.batch_rows:
                 self._seal_full_locked()
             self._expire_locked()
+            wm_after = self._total_rows_written
+            new_sealed = None
+            if self.on_seal is not None and self._next_gen > gen0:
+                # seals append at the tail in gen order: walk back from the
+                # end instead of scanning the whole ring (O(new batches),
+                # not O(total sealed) per write)
+                new_sealed = []
+                for sb in reversed(self._sealed):
+                    if sb.gen < gen0:
+                        break
+                    new_sealed.append(sb)
+                new_sealed.reverse()
+        # Durability hooks run OUTSIDE the lock (journal fsync and peer
+        # sends must not serialize readers) but BEFORE the return — the
+        # return IS the ack, and an acked row must already be journaled.
+        # Thread model unchanged: one writer per table orders the appends.
+        if self.journal is not None:
+            from pixie_tpu.table import journal as _journal
+
+            self.journal.append(_journal.encode_write_record(
+                self.name, self.relation, data, wm_after - n, n))
+        if new_sealed:
+            self.on_seal(self, new_sealed)
         return n
 
     def _take_hot_locked(self) -> dict[str, np.ndarray]:
@@ -271,6 +303,21 @@ class Table:
         """Row id one past the newest row (streaming resume token source)."""
         with self._lock:
             return self._next_row_id + self._hot_rows
+
+    def advance_row_frontier(self, row_id: int) -> None:
+        """Pre-advance an EMPTY table's row-id space to `row_id`: rows
+        below it count as expired-before-restore.  Journal replay uses
+        this when the journal head was pruned (PL_JOURNAL_MAX_MB), so the
+        replayed tail keeps its ABSOLUTE row ids — peer-fetch coverage
+        arithmetic and watermark accounting stay consistent across every
+        consumer instead of silently renumbering rows from zero."""
+        with self._lock:
+            if (self._sealed or self._hot_rows
+                    or self._total_rows_written):
+                raise InvalidArgument(
+                    f"advance_row_frontier on non-empty table {self.name}")
+            self._next_row_id = int(row_id)
+            self._total_rows_written = int(row_id)
 
     def first_row_id(self) -> int:
         """Row id of the oldest RETAINED row — the ring-buffer expiry
@@ -444,6 +491,10 @@ class TableStore:
     def __init__(self):
         self._tables: dict[str, Table] = {}
         self._lock = threading.Lock()
+        #: table-creation observers (durability wiring: a tracepoint table
+        #: deployed after journal attach must start journaling too); called
+        #: OUTSIDE the store lock with the new table
+        self._observers: list = []
         #: schema epoch: bumped whenever the table SET changes (create/drop/
         #: add_table).  Compiled-plan caches key on this — a tracepoint
         #: deploying a new table must miss every plan compiled before it.
@@ -464,12 +515,28 @@ class TableStore:
                 t = Table(name, relation, **kw)
             self._tables[name] = t
             self.epoch += 1
-            return t
+        self._notify(t)
+        return t
+
+    def add_observer(self, fn) -> None:
+        with self._lock:
+            self._observers.append(fn)
+
+    def clear_observers(self) -> None:
+        with self._lock:
+            self._observers.clear()
+
+    def _notify(self, table) -> None:
+        with self._lock:
+            obs = list(self._observers)
+        for fn in obs:
+            fn(table)
 
     def add_table(self, table: Table):
         with self._lock:
             self._tables[table.name] = table
             self.epoch += 1
+        self._notify(table)
 
     def drop(self, name: str) -> None:
         with self._lock:

@@ -339,6 +339,56 @@ def test_chaos_recovery_harness_crash_fails_guards():
     assert all(r["key"].startswith("configs.chaos_recovery") for r in regs)
 
 
+def _chaos_hard_doc(rows=40, row_loss=0, recovery=1.0, bit_equal=1.0,
+                    errors=0, kills=5, wipes=2, recovery_s=2.1,
+                    journal_rows=17_000.0, repl_rows=16_000.0):
+    doc = _doc()
+    doc["configs"]["chaos_recovery_hard"] = {
+        "rows": rows, "queries": rows, "kills": kills, "wipe_kills": wipes,
+        "row_loss": row_loss, "recovery_rate": recovery,
+        "bit_equal_frac": bit_equal, "client_errors": errors,
+        "recovery_s_max": recovery_s, "journal_replayed_rows": journal_rows,
+        "repl_rehydrated_rows": repl_rows,
+    }
+    return doc
+
+
+def test_chaos_recovery_hard_absolute_guards():
+    """ISSUE-12 acceptance held by CI: true pod losses (store dropped, data
+    dir alternately wiped) lose ZERO acknowledged rows, stay bit-equal with
+    zero client errors, recover within the budget — and both recovery paths
+    (journal replay AND peer-fetch rehydration) must actually have run."""
+    assert bench.absolute_floors(_chaos_hard_doc()) == []
+    assert [r["key"] for r in bench.absolute_floors(
+        _chaos_hard_doc(row_loss=1))] == [
+        "configs.chaos_recovery_hard.row_loss"]
+    assert bench.absolute_floors(_chaos_hard_doc(bit_equal=0.99))
+    assert bench.absolute_floors(_chaos_hard_doc(recovery=0.9))
+    assert bench.absolute_floors(_chaos_hard_doc(errors=1))
+    assert bench.absolute_floors(_chaos_hard_doc(recovery_s=30.0))
+    assert bench.absolute_floors(_chaos_hard_doc(kills=1))
+    assert bench.absolute_floors(_chaos_hard_doc(wipes=0))
+    # a run that never replayed a journal or never rehydrated from peers
+    # proved only half the recovery machinery
+    assert bench.absolute_floors(_chaos_hard_doc(journal_rows=0.0))
+    assert bench.absolute_floors(_chaos_hard_doc(repl_rows=0.0))
+    # rides the CI entry point, and smoke shapes trip nothing
+    assert bench.compare_bench(_chaos_hard_doc(), _chaos_hard_doc(row_loss=9),
+                               threshold=0.15)
+    assert bench.absolute_floors(
+        _chaos_hard_doc(rows=12, row_loss=5, bit_equal=0.0, kills=0,
+                        journal_rows=0.0, repl_rows=0.0)) == []
+
+
+def test_chaos_recovery_hard_harness_crash_fails_guards():
+    doc = _doc()
+    doc["configs"]["chaos_recovery_hard"] = {"rows": 40, "error": "boom"}
+    regs = bench.absolute_floors(doc)
+    assert regs and all(r.get("missing") for r in regs)
+    assert all(r["key"].startswith("configs.chaos_recovery_hard")
+               for r in regs)
+
+
 def test_budget_json_line_sheds_diagnostics_keeps_headline():
     """The stdout line must fit the driver's ~2000-char tail cap
     (BENCH_r05's line outgrew it and the round parsed as null): the
