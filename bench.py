@@ -571,8 +571,16 @@ def bench_serving_load(clients, duration_s=8.0, rows=100_000):
     measured p50/p99, goodput, shed rate, per-tenant fairness (max/min
     interactive goodput) and RSS growth; the guard block below holds
     fairness ≤ 2.0 and shed/error/RSS ceilings ABSOLUTELY, and p99/goodput
-    relatively round-over-round."""
-    from pixie_tpu.serving.load_bench import run_load
+    relatively round-over-round.
+
+    Batched-mode shape (ROADMAP item 2): a second measurement drives 100+
+    concurrent warm clients over ONE shared hot table with query batching
+    OFF then ON (matviews off in both arms) — `batched_goodput_qps` must
+    scale superlinearly vs `unbatched_goodput_qps` (ABS floor on
+    `batched_speedup`), every batched result bit-equal to its solo
+    baseline (`batched_bit_equal` floor), and batches must actually form
+    (`batch_size_p50` floor)."""
+    from pixie_tpu.serving.load_bench import run_batched_compare, run_load
 
     try:
         out = run_load(clients=clients, duration_s=duration_s, rows=rows)
@@ -584,7 +592,22 @@ def bench_serving_load(clients, duration_s=8.0, rows=100_000):
             "fairness_ratio", "shed_rate", "shed_rate_interactive",
             "error_rate", "shed_total", "peak_queued", "queue_bounded",
             "rss_growth_mb")
-    return {k: out[k] for k in keep if k in out}
+    got = {k: out[k] for k in keep if k in out}
+    try:
+        # 100+ warm concurrent clients at the full shape; scaled down for
+        # smoke/quick rounds (still concurrent enough for batches to form)
+        bc = run_batched_compare(clients=max(40, min(120, clients // 4)),
+                                 duration_s=max(2.5, duration_s / 2),
+                                 rows=rows)
+        bkeep = ("unbatched_goodput_qps", "batched_goodput_qps",
+                 "batched_speedup", "batch_size_p50", "unbatched_p50_ms",
+                 "batched_p50_ms", "batched_bit_equal", "batch_clients")
+        got.update({k: bc[k] for k in bkeep if k in bc})
+    except Exception as e:  # batched shape must not kill the round either —
+        # but the "error" marker makes the missing batched floors COUNT as
+        # violations at the guarded shape (absolute_floors missing-key rule)
+        got["error"] = f"batched_compare: {type(e).__name__}: {e}"[:200]
+    return got
 
 
 def bench_chaos_recovery_hard(queries, rows=24_576):
@@ -1180,6 +1203,14 @@ def compare_bench(prior, current, threshold):
 ABS_FLOORS = [
     ("configs.interactive_1m.vs_pandas", 5.0, 1_000_000),
     ("configs.serving_load.shed_total", 1.0, 560),
+    # concurrent-query batching acceptance (ROADMAP item 2): at 100+
+    # concurrent warm clients over shared tables, fused batches must beat
+    # the unbatched path (superlinear aggregate goodput), batches must
+    # actually form, and every batched answer must be bit-equal to its
+    # solo baseline
+    ("configs.serving_load.batched_speedup", 1.1, 560),
+    ("configs.serving_load.batch_size_p50", 2.0, 560),
+    ("configs.serving_load.batched_bit_equal", 1.0, 560),
     # chaos_recovery acceptance (ISSUE 10): every retryable query under the
     # injected kill-and-restart schedule recovers, and every recovered
     # answer is BIT-equal to the fault-free baseline

@@ -39,6 +39,11 @@ Checked invariants (each names itself in the raised PlanVerifyError):
   matview-prefix-divergence all producers of an agg_state channel
       canonicalize to the SAME standing-view key (broker matcher and agent
       maintainers must agree on what the state is a function of)
+  batch-slot-missing-sink / batch-slot-overlap
+      fused multi-query (batched) splits: each member slot's renamed sinks
+      exist exactly once in the merger plan and two slots never claim one
+      fused sink (the per-member demux partition contract) — verified once
+      per batch signature, riding the fused split cache
 
 Cost model: one O(ops) walk per distributed split.  Both dispatch sites
 cache splits in the whole-query plan cache keyed by (script, params,
@@ -626,6 +631,61 @@ def verify_distributed(dp, schemas: dict, registry=None) -> None:
 
     verify_plan(dp.merger_plan, schemas, registry,
                 channel_relations=channel_relations, where="merger")
+
+
+# -------------------------------------------------- fused multi-query form
+
+
+def verify_fused_batch(dp, sink_map: dict) -> None:
+    """The fused multi-query (batched) plan form — ran ON TOP of
+    verify_distributed for a batch's merged split, once per batch signature
+    (it rides the fused split cache, so warm batches pay zero
+    re-verification).
+
+    Per-slot invariants (each member query is one slot, its sinks renamed
+    `q{slot}/{name}` by plan fusion):
+
+      batch-slot-missing-sink   every slot sink the demux will read exists
+          exactly once in the merger plan — a slot whose output was lost
+          (or duplicated) in fusion would silently answer the wrong member
+      batch-slot-overlap        two slots never claim the same fused sink —
+          demux by prefix must partition the result set
+
+    Per-slot schema flow and partial-agg mergeability need no extra pass:
+    verify_distributed already types every fused chain op-by-op and checks
+    each agg_state channel's combine/finalize path — the fused plan IS a
+    plan."""
+    sinks = [op.name for op in dp.merger_plan.ops()
+             if isinstance(op, MemorySinkOp)]
+    counts: dict[str, int] = {}
+    for n in sinks:
+        counts[n] = counts.get(n, 0) + 1
+    claimed: dict[str, str] = {}
+    for prefix, m in sorted(sink_map.items()):
+        for orig, fused_name in sorted(m.items()):
+            if counts.get(fused_name, 0) != 1:
+                raise PlanVerifyError(
+                    "batch-slot-missing-sink",
+                    f"slot {prefix!r} output {orig!r} maps to fused sink "
+                    f"{fused_name!r} which appears "
+                    f"{counts.get(fused_name, 0)}x in the merger plan",
+                    where=f"batch slot {prefix}")
+            other = claimed.get(fused_name)
+            if other is not None and other != prefix:
+                raise PlanVerifyError(
+                    "batch-slot-overlap",
+                    f"fused sink {fused_name!r} claimed by slots "
+                    f"{other!r} and {prefix!r}",
+                    where=f"batch slot {prefix}")
+            claimed[fused_name] = prefix
+
+
+def maybe_verify_fused_batch(dp, sink_map: dict) -> None:
+    """verify_fused_batch under the PX_PLAN_VERIFY flag (callers run
+    maybe_verify on the merged split first, inside the same cache fill)."""
+    if not enabled():
+        return
+    verify_fused_batch(dp, sink_map)
 
 
 # ------------------------------------------------------------ dispatch hook

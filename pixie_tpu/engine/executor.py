@@ -1043,6 +1043,67 @@ def gang_merge_states(deferred: list) -> object:
     return fn(*flat)
 
 
+#: multi-query gang fusion: fuse the distinct partial-agg chains of one
+#: shared scan (the fused-batch agent-plan shape, serving/batching.py) into
+#: ONE jitted program per wave — N queries pay one device dispatch per feed
+#: instead of N, and the whole gang reads back in one transfer wave
+_MQ_FUSION = _flags.define_int(
+    "PX_MQ_FUSION", -1,
+    "fuse sibling partial-agg chains sharing one scan into a single jitted "
+    "multi-query program per feed wave (the batched-query device path): "
+    "-1 = auto (on iff a real accelerator backs the dispatch devices — "
+    "the gang amortizes per-execution RTT, while on XLA-CPU the extra "
+    "per-chain-set compiles cost more than they save), 0 = never, "
+    "1 = always (tests / forced proof)")
+
+_HAS_ACCEL: "Optional[bool]" = None
+
+
+def _mq_fusion_enabled() -> bool:
+    v = int(_flags.get("PX_MQ_FUSION"))
+    if v == 0:
+        return False
+    if v >= 1:
+        return True
+    global _HAS_ACCEL
+    if _HAS_ACCEL is None:
+        try:
+            _HAS_ACCEL = any(d.platform != "cpu" for d in jax.devices())
+        except Exception:  # pragma: no cover — backend init failure
+            _HAS_ACCEL = False
+    return _HAS_ACCEL
+
+
+@dataclasses.dataclass
+class _AggSetup:
+    """One aggregate's prepared execution state (see _agg_setup)."""
+
+    op: AggOp
+    head: object
+    chain: list
+    sig: Optional[str]
+    dtypes: dict
+    dicts: dict
+    src: object
+    names: list
+    visible: list
+    time_col: Optional[str]
+    cap: int
+    kern: "ChainKernel"
+    keys: list
+    udas: list
+    in_types: dict
+    init_specs: list
+    num_groups: int
+    seen_name: str
+    step: Callable
+    partial_step: Callable
+    merge_fn: Callable
+    spmd_step: Optional[Callable]
+    val_dicts: dict
+    lut_over: dict
+
+
 class PlanExecutor:
     def __init__(self, plan: Plan, table_store, registry=None, inputs=None,
                  mesh="auto", analyze: bool = False, udtf_ctx=None,
@@ -1476,6 +1537,13 @@ class PlanExecutor:
         # tokens); kernels never bake them.
         src_sig.pop("since_row_id", None)
         src_sig.pop("stop_row_id", None)
+        # The scan's column LIST is not kernel state either: chains prune
+        # to the columns they read, and the pruned dtypes/dicts are in the
+        # signature below.  A fused batch plan widens the shared scan to
+        # the member-column union (plan.fusion._merge_pruned_scans) —
+        # without this pop, every batch composition would re-jit kernels
+        # identical to the solo-warmed ones.
+        src_sig.pop("columns", None)
         if not include_times:
             src_sig.pop("start_time", None)
             src_sig.pop("stop_time", None)
@@ -1941,9 +2009,13 @@ class PlanExecutor:
             in_types=dict(in_types),
         )
 
-    def _agg_state(self, op: AggOp):
-        """Run the aggregation and pull the raw state (shared by the local
-        finalize path and the distributed partial path)."""
+    def _agg_setup(self, op: AggOp):
+        """Everything `_agg_state` needs BEFORE the feed loop runs: chain
+        walk, pruning, cache signatures, the fetched-or-built kernel bundle,
+        and per-run window-origin refresh.  Factored out so the multi-query
+        gang (`_multi_partial_agg`) can prepare N member aggregates against
+        one shared scan and fuse their per-wave steps into a single jitted
+        program.  Raises GroupKeyFallback exactly like `_agg_state`."""
         head, chain = self._upstream_chain(self.plan.parents(op)[0])
         dtypes, dicts, src, names, visible, time_col, cap = self._input_of(head)
         needed = set(op.groups) | {ae.arg for ae in op.values
@@ -2051,6 +2123,44 @@ class PlanExecutor:
                 "window-bin bucket overflowed twice (concurrent ingest "
                 "outpacing kernel rebuild); retry the query"
             )
+        return _AggSetup(
+            op=op, head=head, chain=chain, sig=sig, dtypes=dtypes,
+            dicts=dicts, src=src, names=names, visible=visible,
+            time_col=time_col, cap=cap, kern=kern, keys=keys, udas=udas,
+            in_types=in_types, init_specs=init_specs, num_groups=num_groups,
+            seen_name=seen_name, step=step, partial_step=partial_step,
+            merge_fn=merge_fn, spmd_step=spmd_step, val_dicts=val_dicts,
+            lut_over=lut_over)
+
+    def _agg_state(self, op: AggOp):
+        """Run the aggregation and pull the raw state (shared by the local
+        finalize path and the distributed partial path)."""
+        s = self._agg_setup(op)
+        # one named binding per line: the body below was written against
+        # these locals, and per-line assignment can't transpose fields the
+        # way a parallel-tuple unpack could
+        head = s.head
+        chain = s.chain
+        sig = s.sig
+        src = s.src
+        names = s.names
+        cap = s.cap
+        kern = s.kern
+        keys = s.keys
+        udas = s.udas
+        in_types = s.in_types
+        init_specs = s.init_specs
+        num_groups = s.num_groups
+        seen_name = s.seen_name
+        step = s.step
+        partial_step = s.partial_step
+        merge_fn = s.merge_fn
+        spmd_step = s.spmd_step
+        val_dicts = s.val_dicts
+        lut_over = s.lut_over
+        dtypes = s.dtypes
+        dicts = s.dicts
+        time_col = s.time_col
         # Small host-batch inputs dispatch on the CPU backend (compile is the
         # dominant cost at this scale); the SPMD path stays on the mesh.
         dev_ctx = (self._device_ctx(src)
@@ -2553,6 +2663,173 @@ class PlanExecutor:
             in_types={k: v for k, v in in_types.items()},
         )
 
+    # ------------------------------------------------- multi-query gang
+    def _gang_agg_payloads(self) -> dict:
+        """{channel: PartialAggBatch} for agg_state sinks executed as ONE
+        fused multi-query gang — ≥2 distinct partial aggs sharing a single
+        MemorySourceOp (the fused-batch agent-plan shape).  Empty when
+        fusion is off or inapplicable; such sinks run per-sink as before."""
+        if not _mq_fusion_enabled() or self.analyze:
+            return {}
+        groups: dict[int, list] = {}
+        for sink in self.plan.sinks():
+            if not isinstance(sink, ResultSinkOp) \
+                    or sink.payload != "agg_state":
+                continue
+            parent = self.plan.parents(sink)[0]
+            if not (isinstance(parent, AggOp) and parent.partial):
+                continue
+            try:
+                head, _chain = self._upstream_chain(
+                    self.plan.parents(parent)[0])
+            except Internal:
+                continue
+            if isinstance(head, MemorySourceOp):
+                groups.setdefault(head.id, []).append((sink.channel, parent))
+        out: dict = {}
+        for g in groups.values():
+            # one agg feeding several channels computes ONCE — dedup by op
+            # identity before fusing, then fan the payload out per channel
+            uniq, seen = [], set()
+            for _c, p in g:
+                if id(p) not in seen:
+                    seen.add(id(p))
+                    uniq.append(p)
+            if len(uniq) < 2:
+                continue
+            got = self._multi_partial_agg(uniq)
+            if got is None:
+                continue
+            for cid, parent in g:
+                out[cid] = got[parent.id]
+        return out
+
+    def _multi_partial_agg(self, ops: list) -> Optional[dict]:
+        """Execute N partial aggregates over ONE shared scan as a fused
+        multi-query device program: each feed wave runs a single jitted
+        execution computing EVERY member's partial state (the members'
+        own partial_steps traced together, states stacked in the output
+        tuple), and the whole gang's states read back in one transfer
+        wave — wave RTT and H2D amortize across the batch.  Returns
+        {op.id: PartialAggBatch}, or None when any member is out of scope
+        (callers run the per-sink path; results are bit-identical either
+        way because the fused program calls each member's own unchanged
+        kernel over the same feed contents)."""
+        spmd = self.mesh is not None
+        setups = []
+        for op in ops:
+            try:
+                s = self._agg_setup(op)
+            except GroupKeyFallback:
+                return None  # per-sink path reruns via the sorted fallback
+            if (s.sig is None or s.kern.has_limit or s.val_dicts
+                    or (spmd and s.spmd_step is None)):
+                return None
+            if not setups and not spmd \
+                    and self._backend_for(s.src) != "tpu":
+                # CPU-routed queries keep the per-member np_partial /
+                # wholeplan-native loops (memory-speed paths the fused jit
+                # does not beat); the gang amortizes ACCELERATOR wave RTT —
+                # decided on the FIRST setup so a bail wastes only one
+                return None
+            setups.append(s)
+        # the EARLIEST member's snapshot feeds the gang: later setups'
+        # prescanned key sets cover at least its rows (tables are
+        # append-only), so every member's kernel can encode every fed row
+        src = setups[0].src
+        union_names: list[str] = []
+        for s in setups:
+            for n in s.names:
+                if n not in union_names:
+                    union_names.append(n)
+        # member sigs already carry the mesh size, so plain and spmd gangs
+        # can never collide under one fused-program cache key
+        fkey = ("mq",) + tuple(s.sig for s in setups)
+        fused = _cache_get(fkey)
+        if fused is None:
+            steps = tuple(s.partial_step for s in setups)
+
+            def fused_fn(cols, n_valid, t_lo, t_hi, luts, steps=steps):
+                return tuple(st(cols, n_valid, t_lo, t_hi, l)
+                             for st, l in zip(steps, luts))
+
+            fused_spmd = None
+            if spmd:
+                from pixie_tpu.parallel.spmd import (
+                    reduce_tree_for,
+                    spmd_multi_partial_step,
+                )
+
+                specs = []
+                for s in setups:
+                    init = list(s.init_specs)
+                    g = s.num_groups
+                    specs.append((
+                        s.kern.raw_agg_step,
+                        lambda init=init, g=g: {
+                            name: uda.init(g, in_dt)
+                            for name, uda, in_dt in init},
+                        reduce_tree_for(s.udas),
+                        len(s.kern.limit_ns),
+                    ))
+                fused_spmd = spmd_multi_partial_step(specs, self.mesh)
+            fused = (jax.jit(fused_fn), fused_spmd)
+            _cache_put(fkey, fused)
+        fused_plain, fused_spmd = fused
+        t_lo, t_hi = _time_bounds(setups[0].head)
+        luts = tuple(
+            ({**s.kern.luts, **s.lut_over} if s.lut_over else s.kern.luts)
+            for s in setups)
+        n_dev = self.mesh.size if spmd else 1
+        per_member: list[list] = [[] for _ in setups]
+        with self._timed(f"mq_gang[{len(setups)}]", [op.id for op in ops]):
+            for cols, n_valid in self._feed(src, union_names, setups[0].cap,
+                                            spmd=spmd, backend="tpu"):
+                bucket = _first_len(cols)
+                if spmd and bucket % n_dev == 0:
+                    from pixie_tpu.parallel.spmd import per_shard_valid
+
+                    nv = per_shard_valid(n_valid, bucket, n_dev)
+                    states = fused_spmd(cols, nv, t_lo, t_hi, luts)
+                    self.stats["spmd_feeds"] = (
+                        self.stats.get("spmd_feeds", 0) + 1)
+                    self._note_shard_rows(nv)
+                else:
+                    states = fused_plain(cols, np.int64(n_valid), t_lo,
+                                         t_hi, luts)
+                for parts, st in zip(per_member, states):
+                    parts.append(st)
+            self.stats["mq_waves"] = (self.stats.get("mq_waves", 0)
+                                      + len(per_member[0]))
+            pull = []
+            for s, parts in zip(setups, per_member):
+                if not parts:  # empty scan: identity state per member
+                    pull.append({name: uda.init(s.num_groups, in_dt)
+                                 for name, uda, in_dt in s.init_specs})
+                elif len(parts) == 1:
+                    pull.append(parts[0])
+                else:
+                    # same per-member device merge the unbatched partial
+                    # path runs (finalize_ok False: raw state must stay
+                    # mergeable across agents) — shared cache key included
+                    rt = {name: uda.reduce_ops()
+                          for name, uda, _dt in s.init_specs}
+                    by_name = {name: uda for name, uda, _dt in s.init_specs}
+                    spec_key = ("mfz", False, tuple(
+                        (name, type(uda).__qualname__,
+                         getattr(uda, "q", None))
+                        for name, uda, _dt in s.init_specs))
+                    _finals, rest = _merge_finalize_fn(
+                        spec_key, rt, by_name, finalize_ok=False)(*parts)
+                    pull.append(rest)
+            pulled = transfer.pull(pull)
+        out = {}
+        for s, state_np in zip(setups, pulled):
+            out[s.op.id] = self._finish_partial_batch(
+                s.keys, s.udas, state_np, s.seen_name, s.in_types)
+        self.stats["mq_fused"] = self.stats.get("mq_fused", 0) + len(setups)
+        return out
+
     def run_agent(self) -> dict:
         """Execute an AGENT plan: returns {channel: payload} where payload is a
         HostBatch (rows channels) or PartialAggBatch (agg_state channels)."""
@@ -2560,6 +2837,7 @@ class PlanExecutor:
 
         out = {}
         t0 = _time.perf_counter_ns()
+        gang = self._gang_agg_payloads()
         for sink in self.plan.sinks():
             if isinstance(sink, PartitionSinkOp):
                 # hash-partitioned shuffle edge: one rows channel per bucket.
@@ -2596,7 +2874,10 @@ class PlanExecutor:
             if sink.payload == "agg_state":
                 if not (isinstance(parent, AggOp) and parent.partial):
                     raise Internal("agg_state channel must be fed by a partial AggOp")
-                out[sink.channel] = self._partial_agg_batch(parent)
+                if sink.channel in gang:
+                    out[sink.channel] = gang[sink.channel]
+                else:
+                    out[sink.channel] = self._partial_agg_batch(parent)
             else:
                 out[sink.channel] = self._materialize_parent(parent)
         self.stats["wall_ns"] = _time.perf_counter_ns() - t0
@@ -2621,6 +2902,7 @@ class PlanExecutor:
         from pixie_tpu.plan.plan import PartitionSinkOp
 
         t0 = _time.perf_counter_ns()
+        gang = self._gang_agg_payloads()
         for sink in self.plan.sinks():
             if isinstance(sink, PartitionSinkOp):
                 from pixie_tpu.parallel.repartition import (
@@ -2650,7 +2932,8 @@ class PlanExecutor:
             if sink.payload == "agg_state":
                 if not (isinstance(parent, AggOp) and parent.partial):
                     raise Internal("agg_state channel must be fed by a partial AggOp")
-                pb = self._partial_agg_batch(parent)
+                pb = (gang[sink.channel] if sink.channel in gang
+                      else self._partial_agg_batch(parent))
                 n = pb.num_groups
                 if agg_chunk_groups > 0 and n > agg_chunk_groups:
                     from pixie_tpu.parallel.partial import slice_partial

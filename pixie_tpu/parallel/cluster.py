@@ -13,6 +13,7 @@ milestone) drives over real transport.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Optional
 
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
@@ -212,6 +213,19 @@ class LocalCluster:
         #: per-agent standing-view maintainers (pixie_tpu.matview): repeated
         #: partial-agg fragments answer from O(delta)-refreshed state
         self._mv_managers: dict = {}
+        #: concurrent-query batching rendezvous (PL_QUERY_BATCHING): same
+        #: contract as the networked broker — groupable concurrent queries
+        #: fuse into one dispatch, results demux per member
+        #: (serving/batching.py); built lazily on first groupable query
+        self._batcher = None
+        #: batch signature → (fused plan, sink_map, split-slot) so warm
+        #: repeats of the same member multiset skip re-merge/re-split/
+        #: re-verify entirely
+        self._batch_splits: OrderedDict = OrderedDict()
+        #: concurrent query() calls in flight — the batching gate's
+        #: concurrent-traffic signal (the LocalCluster analog of the
+        #: broker's serving-front in-flight count)
+        self._query_inflight = 0
 
     def matviews(self, agent_name: str):
         # under _mesh_lock: concurrent execute() calls (e.g. the web UI's
@@ -269,6 +283,17 @@ class LocalCluster:
         plan IS the plan a recompile would produce).  `tenant` namespaces
         the plan cache and standing matview state (PL_TENANT_ISOLATION) —
         the same contract the networked broker applies per client."""
+        with self._mesh_lock:
+            self._query_inflight += 1
+        try:
+            return self._query(pxl_source, func, func_args, now,
+                               default_limit, analyze, tenant)
+        finally:
+            with self._mesh_lock:
+                self._query_inflight -= 1
+
+    def _query(self, pxl_source, func, func_args, now, default_limit,
+               analyze, tenant):
         from pixie_tpu.compiler import compile_pxl
         from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
 
@@ -282,6 +307,15 @@ class LocalCluster:
                                      registry=self.registry))
         if q.mutations:
             self.apply_mutations(q.mutations)
+        elif not analyze and not getattr(q, "now_sensitive", True):
+            # Concurrent-query batching (PL_QUERY_BATCHING): groupable
+            # concurrent queries over the same (table, scan window, schema
+            # epoch) rendezvous and dispatch as ONE fused plan with a
+            # shared scan; per-member results demux back here.  None =
+            # this query runs the normal path (solo / non-groupable).
+            got = self._maybe_batched_query(q, key, fp, tenant or "")
+            if got is not None:
+                return got
 
         def _split():
             dp = self.planner.plan(q.plan)
@@ -295,6 +329,67 @@ class LocalCluster:
         (dp, _extras), _shit = _QPC.get_split(entry, fp, _split)
         return self.execute(q.plan, analyze=analyze, dp=dp,
                             tenant=tenant or "")
+
+    # ------------------------------------------------- query batching
+    def _maybe_batched_query(self, q, key, fp, tenant: str):
+        """Pass one compiled, cache-eligible query through the shared
+        batching gate (serving/batching.gate).  Returns the member's
+        demuxed results, or None when the query should run the normal path
+        (batching off, non-groupable plan, matview-served shape, or a solo
+        leader)."""
+        from pixie_tpu import flags as _flags
+        from pixie_tpu.serving import batching
+
+        if not batching.enabled():
+            return None
+        with self._mesh_lock:
+            if self._batcher is None:
+                self._batcher = batching.BatchCollector()
+            batcher = self._batcher
+        got = batching.gate(
+            batcher, q.plan, key, fp,
+            float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3,
+            int(_flags.get("PL_BATCH_MAX_QUERIES")),
+            lambda members: self._execute_batch(members, fp),
+            wait_timeout_s=600.0,  # no per-query timeout here: bounded by
+            # the leader's own execution, generously
+            tenant=tenant, registry=self.registry,
+            concurrency=lambda: self._query_inflight >= 2)
+        return got[0] if isinstance(got, tuple) else got
+
+    def _execute_batch(self, members: list, fp) -> list:
+        """Leader path: merge the member plans (shared scans, deduped
+        chains, renamed sinks; identical members share ONE computed slot),
+        split+verify ONCE per batch signature, run one distributed
+        execution, and demux per-member result dicts."""
+        from pixie_tpu.check import planverify
+        from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
+        from pixie_tpu.serving import batching
+
+        slot, plans, slot_of = batching.fused_slot(
+            self._batch_splits, self._mesh_lock, members, self.schemas())
+
+        def _split():
+            dp = self.planner.plan(slot.fused)
+            # the fused form verifies once per batch signature, riding the
+            # split cache exactly like single-query verification
+            planverify.maybe_verify(dp, self.schemas(), self.registry)
+            planverify.maybe_verify_fused_batch(dp, slot.sink_map)
+            return dp, {}
+
+        (dp, _extras), _hit = _QPC.get_split(slot, fp, _split)
+        results = self.execute(slot.fused, dp=dp, tenant="")
+        batching.note_formed(len(members))
+        out = []
+        for i, _m in enumerate(members):
+            res = batching.demux_results(results, slot.sink_map,
+                                         f"q{slot_of[i]}")
+            for qr in res.values():
+                qr.exec_stats["batch"] = {"size": len(members),
+                                          "slots": len(plans),
+                                          "slot": slot_of[i]}
+            out.append(res)
+        return out
 
     def apply_mutations(self, mutations: list) -> None:
         """Deploy tracepoints on every data agent and refresh the planner's

@@ -265,6 +265,46 @@ def spmd_partial_step(raw_step, init_state_fn, reduce_tree, n_limits: int,
     return serialize_cpu_collectives(jax.jit(shard), mesh)
 
 
+def spmd_multi_partial_step(members: list, mesh: Mesh, axis: str = AGENT_AXIS):
+    """Fuse N sibling agg kernels over ONE shared sharded feed into a single
+    SPMD program (the multi-query gang's mesh variant — see
+    engine.executor._multi_partial_agg).
+
+    members: [(raw_step, init_state_fn, reduce_tree, n_limits)] — the same
+    pieces `spmd_partial_step` lifts one at a time.  The fused program runs
+    every member's per-device partial update over the same local shard and
+    merges each member's state in-program (one execution per feed wave for
+    the whole gang instead of N), returning a tuple of replicated states:
+
+      lifted(cols, n_valid, t_lo, t_hi, luts_tuple) -> tuple(states)
+
+    The collective-serialization gate wraps the WHOLE fused program once —
+    fusing N collective merges into one execution is exactly what the
+    CPU-mesh rendezvous lock wants (one execution, one rendezvous set).
+    """
+
+    def local(cols, n_valid, t_lo, t_hi, luts_tuple):
+        outs = []
+        for (raw_step, init_state_fn, reduce_tree, n_limits), luts in zip(
+                members, luts_tuple):
+            state = init_state_fn()
+            limits = jnp.full((max(1, n_limits),), np.iinfo(np.int64).max,
+                              dtype=jnp.int64)
+            new_state, _cnt, _consumed = raw_step(
+                cols, n_valid[0], t_lo, t_hi, limits, luts, state
+            )
+            outs.append(collective_merge(new_state, reduce_tree, axis))
+        return tuple(outs)
+
+    shard = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P(), P()),
+        out_specs=P(),
+    )
+    return serialize_cpu_collectives(jax.jit(shard), mesh)
+
+
 def shard_batches(cols: dict, n_devices: int) -> dict:
     """Host helper: split padded columns into [n_dev, rows/n_dev] blocks.
 

@@ -120,7 +120,14 @@ def _descendants_project(op, children: dict) -> bool:
             continue  # sinks terminate the walk
         if isinstance(c, MapOp):
             continue  # explicit full output list: nothing leaks past it
-        if isinstance(c, (FilterOp, LimitOp, JoinOp, AggOp)):
+        if isinstance(c, AggOp):
+            # agg output is exactly groups + value out_names: extra INPUT
+            # columns never reach its consumers, so the walk ends here.
+            # (Sibling-AGG merging widens the agg's own output and checks
+            # the agg's consumers separately — this guard is about ops
+            # UPSTREAM of the agg, e.g. a widened shared scan.)
+            continue
+        if isinstance(c, (FilterOp, LimitOp, JoinOp)):
             stack.extend(children.get(c.id, []))
             continue
         return False  # unknown consumer: don't risk schema leaks

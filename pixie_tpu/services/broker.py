@@ -437,6 +437,16 @@ class Broker:
         #: self-telemetry spans for the query path; shipped to an agent's
         #: spans table at query end (the broker holds no scanned store)
         self.tracer = trace.Tracer("broker")
+        #: concurrent-query batching rendezvous (PL_QUERY_BATCHING):
+        #: groupable concurrent queries fuse into ONE distributed dispatch
+        #: with a shared scan; results demux per member (serving/batching)
+        from pixie_tpu.serving import batching as _batching
+
+        self._batcher = _batching.BatchCollector()
+        #: batch signature → BatchSlot (fused plan + sink map + split slot)
+        from collections import OrderedDict as _OrderedDict
+
+        self._batch_splits: "_OrderedDict" = _OrderedDict()
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
@@ -1212,7 +1222,7 @@ class Broker:
 
     def _await_agents(self, ctx: _QueryCtx, req_id: str, entry, q, dp,
                       split_extras, base_meta: dict, reg, fault: dict,
-                      retries: int):
+                      retries: int, extra_verify=None):
         """Wait for every needed agent's answer, surviving evictions and
         stragglers: evicted fragments re-plan onto the live agent set and
         re-dispatch with jittered exponential backoff (bounded by
@@ -1267,7 +1277,7 @@ class Broker:
                 try:
                     dp, split_extras = self._redispatch(
                         ctx, req_id, entry, q, dp, split_extras, base_meta,
-                        reg, fault)
+                        reg, fault, extra_verify=extra_verify)
                 except (Unavailable, CompilerError):
                     # the cluster cannot serve the query right now (e.g.
                     # the killed agent has not re-registered): burn the
@@ -1290,7 +1300,8 @@ class Broker:
             ctx.wake.clear()
 
     def _redispatch(self, ctx: _QueryCtx, req_id: str, entry, q, dp,
-                    split_extras, base_meta: dict, reg, fault: dict):
+                    split_extras, base_meta: dict, reg, fault: dict,
+                    extra_verify=None):
         """One re-plan + re-dispatch round: re-split over the LIVE agent
         set and dispatch every uncovered fragment under fresh tokens.
         Accepted results (and in-flight dispatches) whose fragments are
@@ -1327,10 +1338,14 @@ class Broker:
             with trace.span("plan_split", redispatch=True):
                 dp2 = DistributedPlanner(spec).plan(q.plan)
                 # the re-planned split dispatches too: same pre-dispatch
-                # verification contract as the first round
+                # verification contract as the first round — INCLUDING the
+                # fused-batch demux invariants for batched carriers (the
+                # re-split is cached into the batch slot for warm repeats)
                 from pixie_tpu.check import planverify
 
                 planverify.maybe_verify(dp2, spec.combined_schemas(), reg)
+                if extra_verify is not None:
+                    extra_verify(dp2)
                 extras = {"plan_json": {
                     a: _json.dumps(p.to_dict())
                     for a, p in dp2.agent_plans.items()
@@ -1595,6 +1610,134 @@ class Broker:
                 self._deploy_mutations(q.mutations)
             topo_epoch = self.registry.epoch  # BEFORE cluster_spec (see above)
             spec = self.registry.cluster_spec()  # schemas refreshed by re-register
+        elif not analyze and funcs is None \
+                and not getattr(q, "now_sensitive", True):
+            # Concurrent-query batching (PL_QUERY_BATCHING): groupable
+            # concurrent queries over the same (table, scan window,
+            # topology epoch) rendezvous at the serving front's dispatch
+            # seam and execute as ONE fused distributed query; results
+            # demux back per member.  None = run the normal path.
+            got = self._maybe_batched(q, key, spec, topo_epoch, failover,
+                                      tenant, ticket)
+            if got is not None:
+                return got
+        return self._run_distributed(
+            q, entry, spec, topo_epoch, failover, analyze, tenant, ticket,
+            plan_cache_hit, sink_map=sink_map)
+
+    # ------------------------------------------------------ query batching
+    def _maybe_batched(self, q, key, spec, topo_epoch, failover, tenant,
+                       ticket):
+        """Pass one compiled, cache-eligible query through the shared
+        batching gate (serving/batching.gate).  Returns (results, stats)
+        when the query was served through a fused batch, or None to run
+        the normal path (batching off, non-groupable plan, matview-shaped
+        member, solo leader)."""
+        from pixie_tpu.serving import batching
+
+        reg = self.udf_registry
+        if reg is None:
+            from pixie_tpu.udf import registry as reg
+        got = batching.gate(
+            self._batcher, q.plan, key, topo_epoch,
+            float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3,
+            int(_flags.get("PL_BATCH_MAX_QUERIES")),
+            lambda members: self._execute_batch(members, spec, topo_epoch,
+                                                failover, reg),
+            wait_timeout_s=self.query_timeout_s + 30.0,
+            tenant=tenant, ticket=ticket, registry=reg,
+            # concurrent-traffic signal: other queries executing past
+            # admission right now (members waiting in a batch hold their
+            # slots, so sustained concurrency keeps this ≥ 2; a lone
+            # sequential client sees only itself and never waits)
+            concurrency=lambda: (self.serving.enabled()
+                                 and self.serving.inflight >= 2))
+        if got is None:
+            return None
+        results, stats = got
+        b = (stats or {}).get("batch") or {}
+        if b.get("t0_unix_ns"):
+            # ONE batch_exec span under every member's query root (leaders
+            # and waiters alike): the cross-query group marker
+            trace.event_span("batch_exec", b["t0_unix_ns"],
+                             b.get("wall_ns", 0),
+                             size=b.get("size"), slot=b.get("slot"))
+        return results, stats
+
+    def _execute_batch(self, members, spec, topo_epoch, failover, reg):
+        """Batch-leader path: merge the member plans (shared scans, deduped
+        chains, per-slot renamed sinks; identical members share ONE
+        computed slot), split+verify once per batch signature riding the
+        split cache, run ONE fault-tolerant distributed dispatch (an
+        evicted agent's WHOLE fused fragment re-dispatches — the pinned
+        mid-batch recovery semantic), and demux per-member
+        (results, stats)."""
+        import time as _time
+        import types
+
+        from pixie_tpu.check import planverify
+        from pixie_tpu.serving import batching
+
+        k = len(members)
+        slot, plans, slot_of = batching.fused_slot(
+            self._batch_splits, self._qlock, members,
+            spec.combined_schemas())
+        # DRR cost-accounting: each member was admitted at the full plan
+        # cost estimate; the batch executes ~one dispatch, so charge the
+        # amortized share (refunds queued members' deficit — batching must
+        # not distort tenant fairness)
+        for m in members:
+            if m.ticket is not None:
+                self.serving.rebate(m.ticket, m.ticket.cost / k)
+        fused_q = types.SimpleNamespace(plan=slot.fused, mutations=[],
+                                        now_sensitive=False)
+        # the batch_exec span lands on EVERY member root (leader included)
+        # via the event emission in _maybe_batched — no cm span here, or
+        # the leader's root would carry it twice
+        t0_ns = _time.time_ns()
+        results, stats = self._run_distributed(
+            fused_q, slot, spec, topo_epoch, failover, False,
+            "__batch__", None, plan_cache_hit=False,
+            extra_verify=lambda dp: planverify.maybe_verify_fused_batch(
+                dp, slot.sink_map))
+        wall_ns = _time.time_ns() - t0_ns
+        batching.note_formed(k)
+        out = []
+        for i, m in enumerate(members):
+            res = batching.demux_results(results, slot.sink_map,
+                                         f"q{slot_of[i]}")
+            st = dict(stats)
+            st["batch"] = {"size": k, "slots": len(plans),
+                           "slot": slot_of[i], "t0_unix_ns": t0_ns,
+                           "wall_ns": wall_ns}
+            st["serving"] = {
+                "tenant": m.tenant,
+                "queued_ms": (round(m.ticket.wait_ns / 1e6, 3)
+                              if m.ticket is not None and m.ticket.queued
+                              else 0.0),
+                "cost": m.ticket.cost if m.ticket is not None else None,
+                "degraded": stats.get("serving", {}).get("degraded", False),
+            }
+            for qr in res.values():
+                qr.exec_stats["batch"] = st["batch"]
+            out.append((res, st))
+        return out
+
+    def _run_distributed(
+        self, q, entry, spec, topo_epoch, failover, analyze, tenant,
+        ticket, plan_cache_hit, sink_map=None, extra_verify=None,
+    ) -> tuple[dict[str, QueryResult], dict]:
+        """Split (cached per topology epoch), dispatch to agents with the
+        fault-tolerant machinery, fold/merge, run the merger plan, and
+        assemble per-query stats — the shared back half of
+        `_execute_script_inner` and the fused-batch leader path
+        (`_execute_batch`, which passes the merged plan as `q` and the
+        batch-signature slot as `entry` so warm batches ride the same
+        split cache)."""
+        import time as _time
+
+        from pixie_tpu import metrics as _metrics
+        from pixie_tpu.status import Internal, Unavailable
 
         def _split():
             with trace.span("plan_split"):
@@ -1606,6 +1749,8 @@ class Broker:
 
                 planverify.maybe_verify(dp, spec.combined_schemas(),
                                         self.udf_registry)
+                if extra_verify is not None:
+                    extra_verify(dp)
                 # pre-serialize the per-agent plan dicts: the dispatch loop
                 # splices these cached JSON fragments into each execute
                 # frame instead of re-walking + re-dumping the plan per query
@@ -1689,7 +1834,7 @@ class Broker:
             if dp.agent_plans:
                 dp, split_extras = self._await_agents(
                     ctx, req_id, entry, q, dp, split_extras, base_meta,
-                    reg, fault, retries)
+                    reg, fault, retries, extra_verify=extra_verify)
             if ctx.error:
                 raise Unavailable(ctx.error)
             mv_keys = {}

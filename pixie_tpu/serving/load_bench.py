@@ -339,6 +339,167 @@ def run_load(clients: int = 560, duration_s: float = 8.0,
     }
 
 
+#: the batched-mode warm population: distinct groupable dashboard scripts
+#: over ONE shared hot table — fused batches share the scan and the per-
+#: wave device program across them (identical scripts additionally dedup
+#: to a single chain).  Deliberately NOT matview-shaped in the measured
+#: arms: matviews are disabled for both arms so the comparison isolates
+#: the batching layer (view-shaped members leave batches by design).
+BATCH_SCRIPTS = [
+    """
+df = px.DataFrame(table='http_events')
+df = df[df.status != 404]
+df = df.groupby(['service', 'status']).agg(
+    cnt=('latency', px.count), avg_lat=('latency', px.mean))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df[df.latency > 10.0]
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mx=('latency', px.max))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df.groupby('status').agg(p50=('latency', px.p50),
+                              p99=('latency', px.p99))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 200]
+df = df.groupby('service').agg(avg=('latency', px.mean),
+                               mn=('latency', px.min))
+px.display(df, 'out')
+""",
+]
+
+
+def _fingerprint(results: dict) -> bytes:
+    """Order-insensitive BIT-exact fingerprint of one query's result set —
+    the same definition every other bit-equality proof in the repo uses
+    (a one-ulp float difference fails)."""
+    from pixie_tpu.services.chaos_bench import canonical_bytes
+
+    return canonical_bytes(results)
+
+
+def run_batched_compare(clients: int = 120, duration_s: float = 3.0,
+                        rows: int = 100_000, n_agents: int = 2,
+                        conns: int = 8) -> dict:
+    """The concurrent-query batching proof (ROADMAP item 2): `clients`
+    closed-loop warm clients over ONE shared hot table, measured twice —
+    PL_QUERY_BATCHING off then on (matviews off in both arms so the
+    comparison isolates batching).  Reports aggregate goodput for both
+    arms, the speedup (the superlinear-vs-unbatched guard input), the
+    realized batch-size p50, and per-query bit-equality against solo
+    baselines.  Everything is measured from real broker+agent runs over
+    framed TCP — no modeled numbers."""
+    from pixie_tpu import flags
+    from pixie_tpu.serving import batching
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client, QueryError
+
+    import pixie_tpu.matview  # noqa: F401 — defines PL_MATVIEW_ENABLED
+
+    saved = {name: flags.get(name) for name in (
+        "PL_SERVING_ENABLED", "PL_SERVING_MAX_INFLIGHT",
+        "PL_SERVING_QUEUE_DEPTH", "PL_SERVING_QUEUE_TIMEOUT_S",
+        "PL_SERVING_SHED_WATERMARK", "PL_MATVIEW_ENABLED",
+        "PL_QUERY_BATCHING")}
+    flags.set_for_testing("PL_SERVING_ENABLED", True)
+    flags.set_for_testing("PL_SERVING_MAX_INFLIGHT", 16)
+    flags.set_for_testing("PL_SERVING_QUEUE_DEPTH", max(64, clients))
+    flags.set_for_testing("PL_SERVING_QUEUE_TIMEOUT_S", 60.0)
+    flags.set_for_testing("PL_SERVING_SHED_WATERMARK", 4 * clients)
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0).start()
+    stores = {f"pem{i}": _mkstore(i + 1, rows) for i in range(n_agents)}
+    agents = [Agent(n, "127.0.0.1", broker.port, store=st,
+                    heartbeat_s=1.0).start() for n, st in stores.items()]
+    pool = [Client("127.0.0.1", broker.port, timeout_s=90.0)
+            for _ in range(conns)]
+
+    def drive(seconds: float) -> dict:
+        deadline = time.monotonic() + seconds
+        oks = [0] * clients
+        mism = [0]
+        lat: list[list] = [[] for _ in range(clients)]
+
+        def loop(idx: int):
+            conn = pool[idx % len(pool)]
+            script = BATCH_SCRIPTS[idx % len(BATCH_SCRIPTS)]
+            base = baselines[idx % len(BATCH_SCRIPTS)]
+            while time.monotonic() < deadline:
+                t0 = time.perf_counter()
+                try:
+                    got = conn.execute_script(
+                        script, tenant=f"t{idx % 3}")
+                except QueryError:
+                    continue
+                lat[idx].append(time.perf_counter() - t0)
+                if _fingerprint(got) != base:
+                    mism[0] += 1
+                oks[idx] += 1
+
+        threads = [threading.Thread(target=loop, args=(i,), daemon=True)
+                   for i in range(clients)]
+        t_start = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        measured = time.monotonic() - t_start
+        all_lat = [x for xs in lat for x in xs]
+        return {"goodput_qps": sum(oks) / measured,
+                "p50_ms": _pct(all_lat, 0.5) * 1000,
+                "ok": sum(oks), "mismatches": mism[0]}
+
+    def arm(batched: bool) -> dict:
+        flags.set_for_testing("PL_QUERY_BATCHING", batched)
+        # warm every script's plan-cache entry (and XLA kernels), then one
+        # short CONCURRENT burst so batch signatures / fused splits are
+        # warm too — the measured window is steady-state in both arms
+        for s in BATCH_SCRIPTS:
+            pool[0].execute_script(s)
+        drive(min(1.5, duration_s / 2))
+        return drive(duration_s)
+
+    try:
+        # solo baselines (batching irrelevant at concurrency 1)
+        flags.set_for_testing("PL_QUERY_BATCHING", False)
+        baselines = [_fingerprint(pool[0].execute_script(s))
+                     for s in BATCH_SCRIPTS]
+        un = arm(False)
+        batching.reset_for_testing()
+        ba = arm(True)
+    finally:
+        for c in pool:
+            c.close()
+        for a in agents:
+            a.stop()
+        broker.stop()
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+    speedup = ba["goodput_qps"] / max(un["goodput_qps"], 1e-9)
+    return {
+        "batch_clients": clients,
+        "unbatched_goodput_qps": round(un["goodput_qps"], 1),
+        "batched_goodput_qps": round(ba["goodput_qps"], 1),
+        "batched_speedup": round(speedup, 3),
+        "batch_size_p50": batching.recent_size_p50(),
+        "unbatched_p50_ms": round(un["p50_ms"], 1),
+        "batched_p50_ms": round(ba["p50_ms"], 1),
+        "batched_bit_equal": int(ba["mismatches"] == 0
+                                 and un["mismatches"] == 0),
+        "batched_queries": ba["ok"],
+        "unbatched_queries": un["ok"],
+    }
+
+
 def main(argv=None):  # pragma: no cover — exercised via bench.py
     import argparse
     import json

@@ -268,6 +268,34 @@ class ServingFront:
                     help_="successfully completed queries per tenant")
             self._dispatch_locked()
 
+    def rebate(self, ticket: Optional[Ticket], new_cost: float) -> None:
+        """Re-price an admitted query DOWN to `new_cost` (its amortized
+        share of a fused batch dispatch — serving/batching.py).  A queued
+        member paid its full estimated cost out of its tenant's DRR
+        deficit at dispatch; refunding the difference keeps fair-share
+        drain rates honest: a batch of k warm queries consumed ~one
+        dispatch of broker work, not k.  The refund is capped at the same
+        deficit bound `_dispatch_locked` tops up against (no banking past
+        the anti-burst cap)."""
+        if ticket is None or not ticket.accounted or not enabled():
+            return
+        new_cost = max(float(new_cost), 0.0)
+        refund = ticket.cost - new_cost
+        if refund <= 0:
+            return
+        with self._lock:
+            ticket.cost = new_cost
+            st = self._tenants.get(ticket.tenant)
+            if st is not None and ticket.queued:
+                st.deficit = min(
+                    st.deficit + refund,
+                    max(2.0 * COST_COLD * st.weight, COST_COLD))
+        metrics.counter_inc(
+            "px_serving_batch_rebates_total",
+            labels={"tenant": self._label(ticket.tenant)},
+            help_="admitted queries re-priced to their amortized batch "
+                  "share (DRR deficit refunded for queued members)")
+
     # --------------------------------------------------------------- internals
     def _retry_hint_locked(self, cap: int) -> float:
         # crude drain-time estimate: queued work over capacity, floored at

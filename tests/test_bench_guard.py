@@ -281,9 +281,9 @@ def test_serving_load_harness_crash_fails_guards():
     doc["configs"]["serving_load"] = {"rows": 560,
                                       "error": "RuntimeError: boom"}
     regs = bench.absolute_floors(doc)
-    n_serving = len([k for k, *_ in bench.ABS_CEILINGS
+    n_serving = len([k for k, *_ in bench.ABS_CEILINGS + bench.ABS_FLOORS
                      if k.startswith("configs.serving_load")])
-    assert len(regs) == n_serving + 1  # +1 shed_total floor
+    assert len(regs) == n_serving
     assert all(r.get("missing") for r in regs)
     assert all(r["key"].startswith("configs.serving_load") for r in regs)
     assert "missing at guarded shape" in bench._format_regression(regs[0])
@@ -440,3 +440,49 @@ def test_check_regressions_rejects_unparsed(tmp_path):
     f = tmp_path / "empty.json"
     f.write_text(json.dumps({"parsed": None, "tail": "truncated..."}))
     assert bench.check_regressions(str(f), threshold=0.15) == 2
+
+
+def _batched_doc(rows=560, speedup=1.5, size_p50=4.0, bit_equal=1,
+                 **kw):
+    doc = _serving_doc(rows=rows, **kw)
+    doc["configs"]["serving_load"].update({
+        "unbatched_goodput_qps": 30.0,
+        "batched_goodput_qps": 30.0 * speedup,
+        "batched_speedup": speedup,
+        "batch_size_p50": size_p50,
+        "batched_bit_equal": bit_equal,
+        "batch_clients": 120,
+    })
+    return doc
+
+
+def test_serving_load_batched_floors():
+    """ISSUE-13: the batched-mode shape holds ABSOLUTELY at the full
+    serving_load shape — aggregate goodput at 100+ concurrent warm queries
+    must scale superlinearly vs the unbatched path (speedup floor), batches
+    must actually form (batch_size_p50 floor), and every batched answer
+    must be bit-equal to its solo baseline."""
+    assert bench.absolute_floors(_batched_doc()) == []
+    regs = bench.absolute_floors(_batched_doc(speedup=0.9))
+    assert [r["key"] for r in regs] == [
+        "configs.serving_load.batched_speedup"]
+    assert regs[0]["floor"] == 1.1
+    assert "below floor" in bench._format_regression(regs[0])
+    assert bench.absolute_floors(_batched_doc(size_p50=1.0))
+    assert bench.absolute_floors(_batched_doc(bit_equal=0))
+    # smoke shape (60 clients) never trips the full-shape floors
+    assert bench.absolute_floors(
+        _batched_doc(rows=60, speedup=0.5, size_p50=0.0)) == []
+
+
+def test_serving_load_batched_harness_crash_trips_floors():
+    """A crashed batched-compare harness (error marker + missing batched
+    keys at the guarded shape) FAILS the floors instead of silently
+    disabling them."""
+    doc = _serving_doc()
+    doc["configs"]["serving_load"]["error"] = "batched_compare: Boom: x"
+    regs = bench.absolute_floors(doc)
+    keys = {r["key"] for r in regs}
+    assert "configs.serving_load.batched_speedup" in keys
+    assert all(r.get("missing") for r in regs
+               if r["key"].startswith("configs.serving_load.batched"))

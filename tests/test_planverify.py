@@ -450,3 +450,116 @@ def test_broker_rejects_mutated_split_pre_dispatch(broker_cluster,
     assert ei.value.invariant == "not-mergeable"
     # nothing was dispatched — no query context leaked
     assert not broker_cluster._queries
+
+
+# --------------------------------------------- fused multi-query (batch) form
+
+AGG2_SRC = """
+import px
+df = px.DataFrame(table='http_events')
+df = df.groupby(['status']).agg(mx=('latency', px.max))
+px.display(df, 'out')
+"""
+
+
+def _fused_batch_split():
+    from pixie_tpu.serving import batching
+
+    q1 = compile_pxl(AGG_SRC, SCHEMAS, now=NOW)
+    q2 = compile_pxl(AGG2_SRC, SCHEMAS, now=NOW)
+    fused, sink_map = batching.fuse_members(
+        [("q0", q1.plan), ("q1", q2.plan)], SCHEMAS)
+    dp = DistributedPlanner(_spec()).plan(fused)
+    return dp, sink_map
+
+
+def test_fused_batch_form_verifies_clean():
+    """A fused multi-query split passes BOTH the typed pass (it is a plan
+    like any other — per-slot schema flow and agg mergeability included)
+    and the batch-slot demux invariants."""
+    from pixie_tpu.check.planverify import verify_fused_batch
+
+    dp, sink_map = _fused_batch_split()
+    verify_distributed(dp, SCHEMAS)
+    verify_fused_batch(dp, sink_map)
+
+
+def test_fused_batch_missing_slot_sink_rejected():
+    """A slot whose fused sink was lost (or never produced) must be
+    rejected — demux would silently answer the wrong member."""
+    from pixie_tpu.check.planverify import verify_fused_batch
+
+    dp, sink_map = _fused_batch_split()
+    bad = {p: dict(m) for p, m in sink_map.items()}
+    bad["q1"]["out"] = "q1/definitely_not_there"
+    with pytest.raises(PlanVerifyError) as e:
+        verify_fused_batch(dp, bad)
+    assert e.value.invariant == "batch-slot-missing-sink"
+    assert "q1" in str(e.value)
+
+
+def test_fused_batch_slot_overlap_rejected():
+    """Two slots claiming one fused sink break the demux partition."""
+    from pixie_tpu.check.planverify import verify_fused_batch
+
+    dp, sink_map = _fused_batch_split()
+    bad = {p: dict(m) for p, m in sink_map.items()}
+    bad["q1"]["out"] = bad["q0"]["out"]
+    with pytest.raises(PlanVerifyError) as e:
+        verify_fused_batch(dp, bad)
+    assert e.value.invariant == "batch-slot-overlap"
+
+
+def test_fused_batch_verification_rides_split_cache():
+    """The batch leader verifies ONCE per batch signature: a warm repeat of
+    the same member multiset re-verifies nothing (the fused split-cache
+    slot is filled)."""
+    import threading
+
+    import pixie_tpu.matview  # noqa: F401
+    from pixie_tpu.parallel.cluster import LocalCluster
+    from pixie_tpu.table import TableStore
+
+    saved = {n: flags.get(n) for n in ("PL_MATVIEW_ENABLED",
+                                       "PL_BATCH_WINDOW_MS",
+                                       "PL_QUERY_BATCHING")}
+    flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+    flags.set_for_testing("PL_BATCH_WINDOW_MS", 150.0)
+    flags.set_for_testing("PL_QUERY_BATCHING", True)
+    try:
+        ts = TableStore()
+        t = ts.create("http_events", HTTP_REL, batch_rows=4096)
+        rng = np.random.default_rng(3)
+        n = 8192
+        t.write({"time_": np.arange(n, dtype=np.int64),
+                 "service": rng.choice(["a", "b"], n).tolist(),
+                 "latency": rng.exponential(5.0, n),
+                 "status": rng.choice([200, 404], n)})
+        cluster = LocalCluster({"pem0": ts})
+
+        def round_trip():
+            got = {}
+
+            def run(tag, s):
+                got[tag] = cluster.query(s)["out"]
+
+            th = [threading.Thread(target=run, args=("a", AGG_SRC)),
+                  threading.Thread(target=run, args=("b", AGG2_SRC))]
+            for x in th:
+                x.start()
+            for x in th:
+                x.join(timeout=60)
+            return got
+
+        v0 = metrics.counter_value("px_plan_verify_total")
+        round_trip()
+        v1 = metrics.counter_value("px_plan_verify_total")
+        round_trip()  # warm batch signature: split cache hit, zero verify
+        v2 = metrics.counter_value("px_plan_verify_total")
+        b = metrics.counter_value("px_batch_formed_total")
+        if b >= 2:  # both rounds actually batched (scheduling-dependent)
+            assert v2 == v1
+        assert v1 >= v0
+    finally:
+        for nm, v in saved.items():
+            flags.set_for_testing(nm, v)

@@ -638,3 +638,70 @@ def test_degraded_dispatch_serves_stale_matview(net_cluster):
     mv2 = res2.exec_stats["agents"]["pem1"].get("matview") or {}
     assert mv2.get("hit") and not mv2.get("stale")
     assert mv2.get("rows_folded", 0) >= 50
+
+
+def test_batch_rebate_refunds_amortized_share():
+    """ISSUE-13 DRR cost-accounting fix: a queued member admitted at full
+    estimated cost that then executes inside a fused batch is re-priced to
+    its amortized share — the difference returns to its tenant's DRR
+    deficit (capped), so batching doesn't distort fair-share drain rates.
+    Pass-through / un-queued / disabled cases are no-ops."""
+    _set(PL_SERVING_ENABLED=1, PL_SERVING_MAX_INFLIGHT=1,
+         PL_SERVING_QUEUE_DEPTH=8, PL_TENANT_QPS="",
+         PL_TENANT_CONCURRENCY="", PL_TENANT_WEIGHTS="",
+         PL_SERVING_SHED_WATERMARK=0)
+    front = ServingFront("t")
+    blocker = front.admit("x", COST_WARM)
+    h = _bg_admit(front, "tA", COST_COLD)
+    assert _wait(lambda: front.stats()["queued"] == 1)
+    front.release(blocker)  # dispatches tA's cold query, spending deficit
+    assert _wait(lambda: "ticket" in h and h["ticket"].accounted)
+    t = h["ticket"]
+    before = front.stats()["tenants"]["tA"]["deficit"]
+    # batch of 4: the member owes COST_COLD/4, refund = 3/4 * COST_COLD
+    front.rebate(t, t.cost / 4)
+    after = front.stats()["tenants"]["tA"]["deficit"]
+    assert after - before == pytest.approx(0.75 * COST_COLD)
+    assert t.cost == pytest.approx(COST_COLD / 4)
+    # idempotent-ish: a second rebate to the SAME share refunds nothing
+    front.rebate(t, t.cost)
+    assert front.stats()["tenants"]["tA"]["deficit"] == pytest.approx(after)
+    # never refunds UP (a larger share than admitted is ignored)
+    front.rebate(t, 100 * COST_COLD)
+    assert t.cost == pytest.approx(COST_COLD / 4)
+    front.release(t)
+    # un-accounted tickets (pass-through / released) are no-ops
+    front.rebate(t, 0.0)
+    # disabled front: no accounting to fix
+    _set(PL_SERVING_ENABLED=0)
+    t2 = front.admit("tA", COST_COLD)
+    front.rebate(t2, 0.5)
+    assert t2.cost == COST_COLD
+
+
+def test_batch_rebate_deficit_capped():
+    """The refund cannot bank deficit past the anti-burst cap the dispatch
+    loop tops up against."""
+    _set(PL_SERVING_ENABLED=1, PL_SERVING_MAX_INFLIGHT=1,
+         PL_SERVING_QUEUE_DEPTH=64, PL_TENANT_QPS="",
+         PL_TENANT_CONCURRENCY="", PL_TENANT_WEIGHTS="",
+         PL_SERVING_SHED_WATERMARK=0)
+    front = ServingFront("t")
+    blocker = front.admit("x", COST_WARM)
+    hs = [_bg_admit(front, "tA", COST_COLD) for _ in range(4)]
+    assert _wait(lambda: front.stats()["queued"] == 4)
+    front.release(blocker)
+    assert _wait(lambda: any("ticket" in h and h["ticket"].accounted
+                             for h in hs))
+    running = next(h for h in hs if "ticket" in h and h["ticket"].accounted)
+    t = running["ticket"]
+    for _ in range(8):  # repeated maximal refunds must stay capped
+        t.cost = COST_COLD
+        front.rebate(t, 0.0)
+    cap = max(2.0 * COST_COLD * 1.0, COST_COLD)
+    assert front.stats()["tenants"]["tA"]["deficit"] <= cap
+    front.release(t)
+    for h in hs:
+        if "ticket" in h and h["ticket"] is not t:
+            h["ticket"].event.wait(5.0)
+            front.release(h["ticket"])
