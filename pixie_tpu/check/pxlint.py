@@ -32,6 +32,13 @@ Rules:
       device stream (and deadlock under the XLA-CPU collective gate).
   bad-suppression   a suppression comment without a reason, or naming an
       unknown rule.
+  pxl-columns       a bundled self-telemetry script
+      (``pixie_tpu/scripts/px/self_*/*.pxl``) referencing a table or
+      column that does not exist in the canonical relations
+      (``collect/schemas.py`` ∪ the self-telemetry tables) — the schema
+      registry and the shipped dashboards drift silently otherwise.
+      Tracks frame shapes through ``px.DataFrame`` / filters /
+      ``groupby(...).agg(...)`` assignments, so derived columns count.
 
 Suppression: ``# pxlint: disable=<rule>[,<rule>] -- <reason>`` on (or one
 line above) the flagged statement.  The reason is REQUIRED: findings are
@@ -55,7 +62,7 @@ from typing import Optional
 
 RULES = frozenset({
     "lock-discipline", "env-read", "metric-hygiene", "span-hygiene",
-    "jit-host-callback", "bad-suppression",
+    "jit-host-callback", "bad-suppression", "pxl-columns",
 })
 
 _ENV_NAME = re.compile(r"^(PL_|PX_|PIXIE_TPU_)")
@@ -487,6 +494,221 @@ def _check_jit_host_callback(ctx: _FileCtx) -> None:
                         "deadlock the XLA-CPU collective gate)")
 
 
+# ----------------------------------------------------- pxl column references
+
+
+def _chain_root(node: ast.AST) -> Optional[str]:
+    """The base Name of a call/attribute chain: `df.groupby(..).agg(..)`
+    → 'df'."""
+    while True:
+        if isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def _str_consts(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+class _PxlChecker:
+    """Column-reference lint for one bundled .pxl script: tracks the frame
+    shape through `px.DataFrame` / filter / projection / groupby-agg
+    assignments (sequentially, the shape the bundled scripts use) and flags
+    any table or column reference the canonical relations don't carry."""
+
+    def __init__(self, rel: str, schemas: dict[str, set]):
+        self.rel = rel
+        self.schemas = schemas
+        self.findings: list[Finding] = []
+
+    def check_module(self, tree: ast.Module) -> list[Finding]:
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self._check_fn(node)
+        return self.findings
+
+    def _add(self, node, msg: str) -> None:
+        self.findings.append(Finding(
+            self.rel, getattr(node, "lineno", 0), "pxl-columns", msg))
+
+    # -------------------------------------------------------- frame shapes
+    def _frame_of(self, expr: ast.AST, avail: dict) -> Optional[set]:
+        """Resulting column set of an expression assigned to a variable,
+        or None when it is not a tracked frame."""
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d == "px.DataFrame":
+                for kw in expr.keywords:
+                    if kw.arg == "table" and isinstance(kw.value, ast.Constant):
+                        cols = self.schemas.get(str(kw.value.value))
+                        return set(cols) if cols is not None else None
+                if expr.args and isinstance(expr.args[0], ast.Constant):
+                    cols = self.schemas.get(str(expr.args[0].value))
+                    return set(cols) if cols is not None else None
+                return None
+            if isinstance(expr.func, ast.Attribute) and expr.func.attr == "agg":
+                out = {kw.arg for kw in expr.keywords if kw.arg}
+                base = expr.func.value
+                if isinstance(base, ast.Call) and isinstance(
+                        base.func, ast.Attribute) and base.func.attr == "groupby":
+                    for a in base.args:
+                        out.update(_str_consts(a))
+                return out
+            # other chained calls (head, drop-less shapes): propagate the
+            # base frame's columns when the chain roots at a tracked frame
+            root = _chain_root(expr)
+            if root is not None and avail.get(root) is not None:
+                return set(avail[root])
+            return None
+        if isinstance(expr, ast.Subscript):
+            root = _chain_root(expr)
+            if root is None or avail.get(root) is None:
+                return None
+            proj = _str_consts(expr.slice)
+            if proj:  # df[['a', 'b']] projection narrows the shape
+                return set(proj)
+            return set(avail[root])  # boolean filter keeps it
+        if isinstance(expr, ast.Name):
+            got = avail.get(expr.id)
+            return set(got) if got is not None else None
+        return None
+
+    # -------------------------------------------------------------- checks
+    def _check_reads(self, stmt: ast.stmt, avail: dict) -> None:
+        par = _parents(stmt)
+
+        def cols_of(node) -> Optional[set]:
+            root = _chain_root(node)
+            return avail.get(root) if root is not None else None
+
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d == "px.DataFrame":
+                    table = None
+                    for kw in node.keywords:
+                        if kw.arg == "table" and isinstance(
+                                kw.value, ast.Constant):
+                            table = str(kw.value.value)
+                    if table is not None and table not in self.schemas:
+                        self._add(node, f"unknown table {table!r} (not in "
+                                        "collect/schemas.py ∪ self-telemetry "
+                                        "relations)")
+                    continue
+                if isinstance(node.func, ast.Attribute):
+                    cols = cols_of(node.func.value)
+                    if node.func.attr == "groupby" and cols is not None:
+                        for a in node.args:
+                            for c in _str_consts(a):
+                                if c not in cols:
+                                    self._add(node, f"groupby column {c!r} "
+                                                    "not in the frame")
+                    elif node.func.attr == "agg":
+                        base = node.func.value
+                        if isinstance(base, ast.Call) and isinstance(
+                                base.func, ast.Attribute) \
+                                and base.func.attr == "groupby":
+                            base = base.func.value
+                        bcols = cols_of(base)
+                        if bcols is not None:
+                            for kw in node.keywords:
+                                if isinstance(kw.value, ast.Tuple) \
+                                        and kw.value.elts:
+                                    for c in _str_consts(kw.value.elts[0]):
+                                        if c not in bcols:
+                                            self._add(
+                                                kw.value,
+                                                f"agg input column {c!r} "
+                                                "not in the frame")
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                parent = par.get(node)
+                if isinstance(parent, (ast.Call, ast.Attribute)) and (
+                        getattr(parent, "func", None) is node
+                        or getattr(parent, "value", None) is node):
+                    continue  # method receiver / deeper chain link
+                if isinstance(node.value, ast.Name):
+                    cols = avail.get(node.value.id)
+                    if cols is not None and node.attr not in cols:
+                        self._add(node, f"column {node.attr!r} not in the "
+                                        f"frame {node.value.id!r}")
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(getattr(node, "ctx", None), ast.Load) \
+                    and isinstance(node.value, ast.Name):
+                cols = avail.get(node.value.id)
+                if cols is not None:
+                    for c in _str_consts(node.slice):
+                        if c not in cols:
+                            self._add(node, f"column {c!r} not in the frame "
+                                            f"{node.value.id!r}")
+
+    def _check_fn(self, fn: ast.FunctionDef) -> None:
+        avail: dict[str, Optional[set]] = {}
+        for stmt in fn.body:
+            self._check_reads(stmt, avail)
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = stmt.targets[0]
+                if isinstance(tgt, ast.Name):
+                    avail[tgt.id] = self._frame_of(stmt.value, avail)
+                elif isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name):
+                    cols = avail.get(tgt.value.id)
+                    if cols is not None:  # df.newcol = expr adds a column
+                        cols.add(tgt.attr)
+                elif isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name):
+                    cols = avail.get(tgt.value.id)
+                    if cols is not None:
+                        for c in _str_consts(tgt.slice):
+                            cols.add(c)
+
+
+def _canonical_columns() -> dict[str, set]:
+    from pixie_tpu.collect.schemas import all_schemas
+
+    return {t: {c.name for c in rel} for t, rel in all_schemas().items()}
+
+
+def lint_pxl_scripts(roots: Optional[list] = None) -> list[Finding]:
+    """The pxl-columns rule over every bundled self-telemetry script
+    (``self_*`` bundle dirs) under `roots` (default: the package's
+    scripts/px bundle)."""
+    roots = ([pathlib.Path(p) for p in roots] if roots
+             else [_PKG / "scripts" / "px"])
+    schemas = _canonical_columns()
+    findings: list[Finding] = []
+    for root in roots:
+        if not root.is_dir():
+            continue
+        for f in sorted(root.rglob("*.pxl")):
+            if not f.parent.name.startswith("self_"):
+                continue
+            try:
+                rel = str(f.resolve().relative_to(_REPO))
+            except ValueError:
+                rel = str(f)
+            try:
+                tree = ast.parse(f.read_text(), filename=str(f))
+            except SyntaxError as e:
+                findings.append(Finding(rel, e.lineno or 0, "pxl-columns",
+                                        f"script does not parse: {e.msg}"))
+                continue
+            findings.extend(_PxlChecker(rel, schemas).check_module(tree))
+    return findings
+
+
 # --------------------------------------------------------------------- main
 
 
@@ -527,6 +749,15 @@ def lint_paths(paths: Optional[list] = None) -> list[Finding]:
         _check_jit_host_callback(ctx)
         findings.extend(ctx.findings)
     findings.extend(_finish_metric_hygiene(metric_registry))
+    # bundled self-telemetry scripts: schema-drift lint over the .pxl files
+    # beneath the same roots (default: the package's scripts/px bundle;
+    # explicit FILE paths lint .py only, matching the historical surface)
+    if paths:
+        dirs = [p for p in roots if p.is_dir()]
+        if dirs:
+            findings.extend(lint_pxl_scripts(dirs))
+    else:
+        findings.extend(lint_pxl_scripts(None))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

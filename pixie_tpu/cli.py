@@ -118,6 +118,12 @@ def _demo_cluster():
     SEC = 1_000_000_000
     now = time.time_ns()
     store = build_demo_store(rows=20_000, now_ns=now, span_s=300)
+    # the self-telemetry tables exist (empty) so the bundled self_*
+    # dashboards run against demo data like any other script
+    from pixie_tpu import observe, trace
+
+    trace.ensure_table(store)
+    observe.ensure_self_tables(store)
     return store, now
 
 
@@ -140,6 +146,16 @@ def _render_results(out_name, results, args, displays=None) -> None:
             print("-- exec stats:")
             print(render_stats(res.exec_stats))
         print()
+    if getattr(args, "explain", False):
+        # EXPLAIN ANALYZE: the annotated plan tree + phase attribution +
+        # provenance the flight recorder assembled for THIS query — one
+        # query, ONE block, however many sinks it displayed (the broker
+        # stamps the same stats dict on every result)
+        for res in results.values():
+            if res.exec_stats.get("explain"):
+                print(res.exec_stats["explain"])
+                print()
+                break
 
 
 def cmd_run(args) -> int:
@@ -171,7 +187,8 @@ def cmd_run(args) -> int:
             # one-line note (or a clean error), never a stack trace
             try:
                 out = client.execute_script(
-                    source, func=fn, func_args=fargs, analyze=args.analyze)
+                    source, func=fn, func_args=fargs, analyze=args.analyze,
+                    explain=getattr(args, "explain", False))
             except (QueryError, Unavailable) as e:
                 # Unavailable covers the reconnect path exhausting its
                 # budget (broker down past PL_CLIENT_RETRIES) and timeouts
@@ -196,7 +213,16 @@ def cmd_run(args) -> int:
             q = compile_pxl(source, schemas, func=fn, func_args=fargs, now=now)
             if q.mutations:
                 tp_mgr.apply(q.mutations)
-            return execute_plan(q.plan, store, analyze=args.analyze)
+            t0 = time.perf_counter_ns()
+            results = execute_plan(q.plan, store, analyze=args.analyze)
+            if getattr(args, "explain", False) and results:
+                from pixie_tpu import observe
+
+                first = next(iter(results.values()))
+                first.exec_stats["explain"] = observe.explain_local(
+                    q.plan, first.exec_stats,
+                    time.perf_counter_ns() - t0)
+            return results
 
         if len(runs) > 1:
             # Multi-widget vis: fuse all funcs' plans so shared subplans
@@ -209,7 +235,9 @@ def cmd_run(args) -> int:
             q, sink_map = compile_pxl_funcs(source, schemas, runs, now=now)
             if q.mutations:
                 tp_mgr.apply(q.mutations)
+            t0 = time.perf_counter_ns()
             all_results = execute_plan(q.plan, store, analyze=args.analyze)
+            fused_wall_ns = time.perf_counter_ns() - t0
 
             def execute_fused(out_name):
                 return {
@@ -236,6 +264,13 @@ def cmd_run(args) -> int:
                 if first.exec_stats.get("operators"):
                     print("-- exec stats (fused plan):")
                     print(render_stats(first.exec_stats))
+            if getattr(args, "explain", False) and all_results:
+                # the fused plan ran ONCE for every widget: one EXPLAIN
+                from pixie_tpu import observe
+
+                first = next(iter(all_results.values()))
+                print(observe.explain_local(q.plan, first.exec_stats,
+                                            fused_wall_ns))
             return 0
 
     displays = vis.widget_displays() if vis is not None else {}
@@ -361,6 +396,10 @@ def main(argv=None) -> int:
                           "and per-tenant cache namespaces")
     run.add_argument("--arg", action="append", help="vis variable override k=v")
     run.add_argument("--analyze", action="store_true")
+    run.add_argument("--explain", action="store_true",
+                     help="EXPLAIN ANALYZE: print the annotated plan tree "
+                          "(per-op ns, phase attribution, cache/matview/"
+                          "batch/failover provenance) for each query")
     run.add_argument("--max-rows", type=int, default=40)
     run.set_defaults(fn=cmd_run)
 

@@ -204,10 +204,14 @@ SCHEMAS: dict[str, Relation] = {
 
 def _self_telemetry_schemas() -> dict[str, Relation]:
     # self-telemetry (pixie_tpu observing itself): trace spans of the query
-    # path, owned by pixie_tpu.trace and written on every agent's store
+    # path (pixie_tpu.trace) plus the query flight recorder's tables
+    # (pixie_tpu.observe: per-query profiles, per-op stats, sampled
+    # metrics, SLO alerts) — all written on agent stores through the
+    # normal ingest path and queryable like any connector table
+    from pixie_tpu.observe import SELF_TABLES
     from pixie_tpu.trace import SPANS_RELATION, SPANS_TABLE
 
-    return {SPANS_TABLE: SPANS_RELATION}
+    return {SPANS_TABLE: SPANS_RELATION, **SELF_TABLES}
 
 
 def all_schemas() -> dict[str, Relation]:

@@ -108,6 +108,72 @@ def counter_series(name: str) -> dict[tuple, float]:
                 if n == name}
 
 
+def hist_quantile(name: str, q: float,
+                  labels: Optional[dict] = None) -> Optional[float]:
+    """Quantile estimate from a registered histogram's bucket counts
+    (linear interpolation inside the covering bucket — the
+    histogram_quantile() semantic).  Returns None for an unknown or empty
+    series; observations past the last finite bound clamp to it.  This is
+    the read side the load harness and the self-metrics sampler use, so
+    reported percentiles come from the SAME registry ops scrape."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"hist_quantile: q={q} outside [0, 1]")
+    with _lock:
+        h = _hists.get(_key(name, labels))
+        if h is None or h["count"] == 0:
+            return None
+        bounds, counts, total = h["bounds"], list(h["counts"]), h["count"]
+    rank = q * total
+    cum, lo = 0, 0.0
+    for b, c in zip(bounds, counts):
+        if c > 0 and cum + c >= rank:
+            frac = min(max((rank - cum) / c, 0.0), 1.0)
+            return lo + (b - lo) * frac
+        cum += c
+        lo = b
+    # the remaining mass sits in the implicit +Inf bucket: the honest
+    # answer without an upper bound is the last finite boundary
+    return bounds[-1]
+
+
+def snapshot() -> list[tuple]:
+    """Everything registered, as (kind, name, labels_tuple, value) rows —
+    the metrics-as-data read surface (observe.sample_metrics_rows folds it
+    into self_telemetry.metrics).  Histograms contribute their sum/count
+    plus interpolated p50/p99; lazy gauge fns are evaluated OUTSIDE the
+    registry lock (they run user code), like render()."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = dict(_gauges)
+        gauge_fns = dict(_gauge_fns)
+        hist_keys = [k for k, h in _hists.items() if h["count"] > 0]
+        hist_sums = {k: (_hists[k]["sum"], _hists[k]["count"])
+                     for k in hist_keys}
+    out: list[tuple] = []
+    for (name, labels), v in sorted(counters.items()):
+        out.append(("counter", name, labels, v))
+    for (name, labels), v in sorted(gauges.items()):
+        out.append(("gauge", name, labels, v))
+    for name, (_help, fn) in sorted(gauge_fns.items()):
+        try:
+            vals = fn()
+        except Exception:
+            continue
+        for labels, v in sorted(vals.items()):
+            lt = (labels if isinstance(labels, tuple)
+                  else tuple(sorted(labels.items())))
+            out.append(("gauge", name, lt, float(v)))
+    for name, labels in sorted(hist_keys):
+        s, c = hist_sums[(name, labels)]
+        out.append(("hist_sum", name, labels, s))
+        out.append(("hist_count", name, labels, float(c)))
+        for q, kind in ((0.5, "hist_p50"), (0.99, "hist_p99")):
+            v = hist_quantile(name, q, dict(labels))
+            if v is not None:
+                out.append((kind, name, labels, v))
+    return out
+
+
 def register_gauge_fn(name: str, fn: Callable[[], dict], help_: str = "") -> None:
     """Lazy gauge: fn() -> {labels-tuple-or-frozen-dict: value} evaluated at
     render time (per-table sizes, registry liveness, ...)."""

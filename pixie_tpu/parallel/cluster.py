@@ -174,6 +174,17 @@ class LocalCluster:
     def __init__(self, stores: dict, merger_store: Optional[TableStore] = None,
                  registry=None, n_devices_per_agent: Optional[int] = None):
         self.stores = dict(stores)
+        if self.stores:
+            from pixie_tpu import observe as _observe
+            from pixie_tpu import trace as _trace
+
+            if _trace.enabled():
+                # flight-recorder tables live in the FIRST agent store,
+                # created UP FRONT: a lazy creation mid-run would bump the
+                # store's schema epoch and invalidate warm plan-cache
+                # entries between otherwise-identical queries
+                _observe.ensure_self_tables(
+                    self.stores[sorted(self.stores)[0]])
         self.merger_store = merger_store or TableStore()
         self.registry = registry
         self._meshes: dict = {}
@@ -226,6 +237,13 @@ class LocalCluster:
         #: concurrent-traffic signal (the LocalCluster analog of the
         #: broker's serving-front in-flight count)
         self._query_inflight = 0
+        #: query flight recorder (pixie_tpu.observe): per-query profile +
+        #: op-stat rows buffered here and flushed into the first agent
+        #: store in batches (per-query table writes would be exactly the
+        #: instrumentation tax the observe_overhead gate bounds)
+        from pixie_tpu import observe as _observe
+
+        self._telemetry = _observe.RowBuffer()
 
     def matviews(self, agent_name: str):
         # under _mesh_lock: concurrent execute() calls (e.g. the web UI's
@@ -275,36 +293,133 @@ class LocalCluster:
               func_args: Optional[dict] = None, now: Optional[int] = None,
               default_limit: Optional[int] = None,
               analyze: bool = False,
-              tenant: Optional[str] = None) -> dict[str, QueryResult]:
+              tenant: Optional[str] = None,
+              explain: bool = False) -> dict[str, QueryResult]:
         """Compile a PxL script against the cluster's combined schemas and
         execute it distributed (the ExecuteScript analog).  Warm repeats of
         the same script hit the whole-query plan cache and skip the compile
         and distributed-split work entirely (bit-equal results — the cached
         plan IS the plan a recompile would produce).  `tenant` namespaces
         the plan cache and standing matview state (PL_TENANT_ISOLATION) —
-        the same contract the networked broker applies per client."""
+        the same contract the networked broker applies per client.
+
+        With tracing on (PL_TRACING_ENABLED) every query also leaves a
+        flight-recorder profile in `self_telemetry.query_profiles` on the
+        first agent store; `explain=True` additionally attaches the
+        EXPLAIN ANALYZE text to each result's
+        ``exec_stats["explain"]`` (and works with tracing off)."""
+        import time as _time
+
+        from pixie_tpu import trace as _trace
+
+        prof_on = _trace.enabled() or explain
+        prof: dict = {}
+        t0 = _time.perf_counter_ns()
+        t0_unix = _time.time_ns()
         with self._mesh_lock:
             self._query_inflight += 1
         try:
-            return self._query(pxl_source, func, func_args, now,
-                               default_limit, analyze, tenant)
+            results = self._query(pxl_source, func, func_args, now,
+                                  default_limit, analyze, tenant,
+                                  prof if prof_on else None,
+                                  explain=explain)
+        except Exception as e:
+            if _trace.enabled():
+                self._observe_query(None, prof, tenant, t0_unix,
+                                    _time.perf_counter_ns() - t0,
+                                    explain=False, error=str(e))
+            raise
         finally:
             with self._mesh_lock:
                 self._query_inflight -= 1
+        if prof_on:
+            self._observe_query(results, prof, tenant, t0_unix,
+                                _time.perf_counter_ns() - t0,
+                                explain=explain)
+        return results
+
+    def _observe_query(self, results, prof: dict, tenant, t0_unix: int,
+                       wall_ns: int, explain: bool,
+                       error: str = "") -> None:
+        """Assemble + record one query's flight-recorder profile from its
+        results' exec_stats and the phase timers `_query` filled."""
+        import secrets as _secrets
+
+        from pixie_tpu import observe as _observe
+        from pixie_tpu import trace as _trace
+        from pixie_tpu.serving import slo as _slo
+
+        first = (next(iter(results.values()))
+                 if results else None)
+        es = first.exec_stats if first is not None else {}
+        stats = {
+            "agents": es.get("agents") or {},
+            "merger": {"operators": es.get("operators") or [],
+                       "rows_output": es.get("rows_output", 0)},
+            "phases": prof.get("phases") or {},
+            "fastpath": prof.get("fastpath") or {},
+            "batch": es.get("batch") or {},
+        }
+        c = _trace.current()
+        qid = c[1].trace_id if c is not None else _secrets.token_hex(16)
+        profile, op_rows = _observe.build_profile(
+            qid, tenant or "", "cluster", t0_unix, wall_ns, stats,
+            status="error" if error else "ok", error=error)
+        if explain and results:
+            text = _observe.render_explain(
+                profile, op_rows, plan_text=prof.get("plan_text"))
+            for r in results.values():
+                r.exec_stats["explain"] = text
+        if results:
+            for r in results.values():
+                r.exec_stats["profile"] = profile
+        if _trace.enabled():
+            self._telemetry.add(_observe.PROFILES_TABLE, [profile])
+            self._telemetry.add(_observe.OP_STATS_TABLE, op_rows)
+            _slo.record_query(tenant or "", wall_ns / 1e9, not error)
+            if _slo.configured():
+                # same contract as the broker's per-query hook: burn-rate
+                # edges must reach self_telemetry.alerts on a
+                # LocalCluster-only deployment too
+                mon = _slo.monitor()
+                mon.maybe_evaluate()
+                self._telemetry.add(_observe.ALERTS_TABLE,
+                                    mon.drain_alerts())
+            store = self.stores[sorted(self.stores)[0]]
+            self._telemetry.flush_into(store)
+
+    def flush_telemetry(self) -> int:
+        """Force-flush buffered flight-recorder rows into the first agent
+        store (tests and shutdown paths; the query path flushes in
+        batches)."""
+        store = self.stores[sorted(self.stores)[0]]
+        return self._telemetry.flush_into(store, force=True)
 
     def _query(self, pxl_source, func, func_args, now, default_limit,
-               analyze, tenant):
+               analyze, tenant, prof=None, explain: bool = False):
+        import time as _time
+
         from pixie_tpu.compiler import compile_pxl
         from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
 
         fp = self._schemas_fp()
         key = self.plan_cache.key(pxl_source, func, func_args, default_limit,
                                   fp, tenant=tenant)
+        t_c0 = _time.perf_counter_ns()
         q, entry, _hit = self.plan_cache.get_query(
             key, lambda: compile_pxl(pxl_source, self.schemas(), func=func,
                                      func_args=func_args, now=now,
                                      default_limit=default_limit,
                                      registry=self.registry))
+        phases = None
+        if prof is not None:
+            phases = prof.setdefault("phases", {})
+            phases["compile_ns"] = _time.perf_counter_ns() - t_c0
+            prof["fastpath"] = {"plan_cache_hit": _hit}
+            if explain:
+                from pixie_tpu.plan.debug import explain as _plan_explain
+
+                prof["plan_text"] = _plan_explain(q.plan)
         if q.mutations:
             self.apply_mutations(q.mutations)
         elif not analyze and not getattr(q, "now_sensitive", True):
@@ -326,9 +441,13 @@ class LocalCluster:
             planverify.maybe_verify(dp, self.schemas(), self.registry)
             return dp, {}
 
+        t_s0 = _time.perf_counter_ns()
         (dp, _extras), _shit = _QPC.get_split(entry, fp, _split)
+        if phases is not None:
+            phases["plan_split_ns"] = _time.perf_counter_ns() - t_s0
+            prof["fastpath"]["split_cache_hit"] = _shit
         return self.execute(q.plan, analyze=analyze, dp=dp,
-                            tenant=tenant or "")
+                            tenant=tenant or "", phases=phases)
 
     # ------------------------------------------------- query batching
     def _maybe_batched_query(self, q, key, fp, tenant: str):
@@ -407,7 +526,11 @@ class LocalCluster:
                 a.schemas = self.stores[a.name].schemas()
 
     def execute(self, logical: Plan, analyze: bool = False,
-                dp=None, tenant: str = "") -> dict[str, QueryResult]:
+                dp=None, tenant: str = "",
+                phases: Optional[dict] = None) -> dict[str, QueryResult]:
+        import time as _time
+
+        t_exec0 = _time.perf_counter_ns()
         if dp is None:
             dp = self.planner.plan(logical)
             # direct-plan callers (no plan cache in front) verify here;
@@ -525,6 +648,12 @@ class LocalCluster:
                 payloads[cid].append(payload)
             agent_stats[agent_name] = stats
 
+        t_merge0 = _time.perf_counter_ns()
+        if phases is not None:
+            # the exec window: agent fragments + the coalesced readback
+            # wave; everything after is merge-side work
+            phases["exec_ns"] = t_merge0 - t_exec0
+
         # 2. repartitioned joins: per-partition key-disjoint joins between
         #    the agent stage and the merger (reference splitter shuffle).
         reg = self.registry
@@ -595,4 +724,6 @@ class LocalCluster:
             restamp_result(r, logical, sstore, reg)
             r.exec_stats["agents"] = agent_stats
             r.exec_stats["transfer"] = xfer
+        if phases is not None:
+            phases["merge_ns"] = _time.perf_counter_ns() - t_merge0
         return results

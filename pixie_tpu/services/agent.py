@@ -15,7 +15,7 @@ from typing import Optional
 
 import numpy as np
 
-from pixie_tpu import flags, trace
+from pixie_tpu import flags, observe, trace
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.matview import MatViewManager
 from pixie_tpu.parallel.partial import PartialAggBatch
@@ -103,6 +103,11 @@ class Agent:
         #: broker's registry knows the schema from the first handshake
         self.tracer = trace.Tracer(name)
         trace.ensure_table(self.store)
+        #: flight-recorder tables (query profiles, op stats, metrics,
+        #: alerts) exist before registration too: the broker ships its
+        #: per-query rows here and PxL dashboards scan them like any table
+        observe.ensure_self_tables(self.store)
+        self._self_metrics = None
         #: standing materialized views over this agent's store: repeated
         #: scan→filter→map→partial-agg plans answer from incrementally
         #: refreshed state instead of rescanning (pixie_tpu.matview)
@@ -147,10 +152,25 @@ class Agent:
         if self.healthz is not None:
             self.healthz.start()
         self.matviews.start_refresher()  # no-op unless PL_MATVIEW_REFRESH_S>0
+        period = float(flags.get("PL_SELF_METRICS_S"))
+        if period > 0:
+            from pixie_tpu.services.cron import Ticker
+
+            # metrics-as-data on the agent side: this process's registry
+            # folds into the LOCAL store (no hop — the agent IS the data
+            # plane), stamped with the agent's own service name
+            self._self_metrics = Ticker(
+                f"self_metrics_{self.name}", period,
+                lambda: observe.write_rows(
+                    self.store, observe.METRICS_TABLE,
+                    observe.sample_metrics_rows(self.name))).start()
         return self
 
     def stop(self):
         self._stop.set()
+        if self._self_metrics is not None:
+            self._self_metrics.stop()
+            self._self_metrics = None
         self.matviews.stop_refresher()
         if self.healthz is not None:
             self.healthz.stop()
@@ -304,6 +324,15 @@ class Agent:
                 target=self._write_shipped_spans,
                 args=(payload.get("spans") or [],), daemon=True,
                 name=f"pixie-agent-spans-{self.name}",
+            ).start()
+        elif msg == "telemetry_rows":
+            # broker-shipped flight-recorder rows (query profiles, op
+            # stats, sampled metrics, SLO alerts): same contract as spans
+            threading.Thread(
+                target=self._write_telemetry_rows,
+                args=(payload.get("table"), payload.get("rows") or []),
+                daemon=True,
+                name=f"pixie-agent-telemetry-{self.name}",
             ).start()
         elif msg == "deploy_tracepoint":
             try:
@@ -488,6 +517,18 @@ class Agent:
             _metrics.counter_inc(
                 "px_agent_span_write_errors_total",
                 help_="spans that failed to persist to the local store")
+
+    def _write_telemetry_rows(self, table, rows: list) -> None:
+        try:
+            if table in observe.SELF_TABLES:
+                observe.write_rows(self.store, str(table), rows)
+        except Exception:
+            from pixie_tpu import metrics as _metrics
+
+            _metrics.counter_inc(
+                "px_agent_telemetry_write_errors_total",
+                help_="flight-recorder rows that failed to persist to the "
+                      "local store")
 
     def _flush_trace(self) -> None:
         """Persist buffered spans; never let telemetry failure block the

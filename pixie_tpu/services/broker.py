@@ -437,6 +437,13 @@ class Broker:
         #: self-telemetry spans for the query path; shipped to an agent's
         #: spans table at query end (the broker holds no scanned store)
         self.tracer = trace.Tracer("broker")
+        #: query flight recorder: per-query profile/op-stat rows (and SLO
+        #: alert + sampled-metric rows) buffered here, shipped to an agent
+        #: store alongside the spans (pixie_tpu.observe)
+        from pixie_tpu import observe as _observe
+
+        self._telemetry = _observe.RowBuffer()
+        self._self_metrics: Optional[object] = None
         #: concurrent-query batching rendezvous (PL_QUERY_BATCHING):
         #: groupable concurrent queries fuse into ONE distributed dispatch
         #: with a shared scan; results demux per member (serving/batching)
@@ -538,6 +545,15 @@ class Broker:
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
+        period = float(_flags.get("PL_SELF_METRICS_S"))
+        if period > 0:
+            from pixie_tpu.services.cron import Ticker
+
+            #: metrics-as-data: fold the registry into
+            #: self_telemetry.metrics (and evaluate SLO burn rates) on the
+            #: same cadence dashboards poll at
+            self._self_metrics = Ticker("self_metrics", period,
+                                        self._sample_self_metrics).start()
         if self.elector is not None:
             self.elector.start()
         if self.healthz is not None:
@@ -549,6 +565,9 @@ class Broker:
 
         self._stopped.set()
         self.cron.stop()
+        if self._self_metrics is not None:
+            self._self_metrics.stop()
+            self._self_metrics = None
         if self.healthz is not None:
             self.healthz.stop()
         if self.elector is not None:
@@ -1018,6 +1037,7 @@ class Broker:
                     analyze=bool(meta.get("analyze", False)),
                     funcs=[tuple(f) for f in meta.get("funcs") or []] or None,
                     tenant=tenant,
+                    explain=bool(meta.get("explain", False)),
                 )
                 with trace.span("render"):
                     for name, qr in results.items():
@@ -1057,10 +1077,11 @@ class Broker:
             self._ship_spans()
 
     def _ship_spans(self) -> None:
-        """Persist this broker's finished spans into the data plane: the rows
-        go to one live agent's `self_telemetry.spans` table, so the normal
-        distributed scan path (and any PxL script) sees the full trace —
-        broker spans included — without the broker holding a scanned store.
+        """Persist this broker's finished spans AND flight-recorder rows
+        (query profiles, op stats, sampled metrics, SLO alerts) into the
+        data plane: everything goes to one live agent's self_telemetry
+        tables through the normal write path, so PxL scripts and standing
+        matviews see it without the broker holding a scanned store.
 
         Runs in query finally-blocks: telemetry failure (agent churn racing
         the conn map, dead sockets) must never replace a query's outcome, so
@@ -1068,26 +1089,54 @@ class Broker:
         from pixie_tpu import metrics as _metrics
 
         try:
-            if self.tracer.buffered == 0:
+            if self.tracer.buffered == 0 and len(self._telemetry) == 0:
                 return
             # snapshot: the expiry thread pops entries concurrently
             conns = dict(self._agent_conns)
 
-            def send(rows):
+            def send_to_agent(frame) -> bool:
                 for name in sorted(conns):
                     c = conns[name]
-                    if not c.closed and c.send(
-                            wire.encode_json({"msg": "spans", "spans": rows})):
-                        return
-                _metrics.counter_inc(
-                    "px_broker_trace_spans_unshipped_total", float(len(rows)),
-                    help_="broker spans dropped: no agent accepted them")
+                    if not c.closed and c.send(frame):
+                        return True
+                return False
+
+            def send(rows):
+                if not send_to_agent(wire.encode_json(
+                        {"msg": "spans", "spans": rows})):
+                    _metrics.counter_inc(
+                        "px_broker_trace_spans_unshipped_total",
+                        float(len(rows)),
+                        help_="broker spans dropped: no agent accepted them")
 
             self.tracer.flush(send=send)
+            for table, rows in self._telemetry.drain().items():
+                if not send_to_agent(wire.encode_json(
+                        {"msg": "telemetry_rows", "table": table,
+                         "rows": rows})):
+                    _metrics.counter_inc(
+                        "px_broker_telemetry_rows_unshipped_total",
+                        float(len(rows)),
+                        help_="flight-recorder rows dropped: no agent "
+                              "accepted them")
         except Exception:
             _metrics.counter_inc(
                 "px_broker_trace_ship_errors_total",
                 help_="unexpected failures shipping broker spans")
+
+    def _sample_self_metrics(self) -> None:
+        """PL_SELF_METRICS_S cron body: metrics registry → telemetry rows,
+        SLO burn-rate evaluation → alert rows, one ship."""
+        from pixie_tpu import observe as _observe
+        from pixie_tpu.serving import slo as _slo
+
+        self._telemetry.add(_observe.METRICS_TABLE,
+                            _observe.sample_metrics_rows("broker"))
+        if _slo.configured():
+            mon = _slo.monitor()
+            mon.evaluate()
+            self._telemetry.add(_observe.ALERTS_TABLE, mon.drain_alerts())
+        self._ship_spans()
 
     def _deploy_mutations(self, mutations: list) -> None:
         from pixie_tpu.status import Unavailable
@@ -1477,7 +1526,7 @@ class Broker:
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False, funcs=None,
-        tenant: str = None,
+        tenant: str = None, explain: bool = False,
     ) -> tuple[dict[str, QueryResult], dict]:
         """Compile + distribute + merge (the in-process core of ExecuteScript).
 
@@ -1485,10 +1534,18 @@ class Broker:
         request as ONE fused distributed query (shared scans/filters/aggs
         run once — reference optimizer.h:39 MergeNodesRule); the returned
         stats carry `sink_map` so the caller splits results per widget.
+
+        `explain=True` (EXPLAIN ANALYZE) annotates whatever path ACTUALLY
+        served the query — plan tree, measured phase breakdown, per-op ns,
+        cache/matview/batch/failover provenance — into stats["explain"] +
+        stats["profile"], without changing execution (a matview hit is
+        explained AS a matview hit, not bypassed).
         """
         import time as _time
 
+        from pixie_tpu import observe as _observe
         from pixie_tpu import metrics as _metrics
+        from pixie_tpu.serving import slo as _slo
 
         tenant = str(tenant or DEFAULT_TENANT)
         _metrics.counter_inc("px_broker_queries_total",
@@ -1497,19 +1554,36 @@ class Broker:
         # the networked path _run_query's root is already active and this is
         # a no-op.  Shipping happens only when this frame owns the root.
         owns_root = trace.enabled() and trace.current() is None
+        #: flight recorder: assemble a per-query profile when tracing is on
+        #: (recorded into self_telemetry.*) or explain was requested (the
+        #: per-query opt-in works with tracing off, without recording)
+        prof_on = trace.enabled() or explain
         t0 = _time.perf_counter()
+        t0_unix_ns = _time.time_ns()
         shed = False
+        ok_query = False
+        qid = None
         try:
             with trace.maybe_root(self.tracer, "query"):
+                # captured while the trace root is live: the except block
+                # below runs AFTER the cm unwinds, and an error profile
+                # must still join this query's spans on query_id==trace_id
+                qid = self._query_trace_id() if prof_on else None
                 ticket = self._admit(script, func, func_args, default_limit,
                                      tenant)
                 ok = False
                 try:
                     results, stats = self._execute_script_inner(
                         script, func, func_args, now, default_limit, analyze,
-                        funcs, tenant=tenant, ticket=ticket,
+                        funcs, tenant=tenant, ticket=ticket, explain=explain,
                     )
                     ok = True
+                    ok_query = True
+                    if prof_on:
+                        self._record_profile(
+                            qid, stats, tenant, t0_unix_ns,
+                            int((_time.perf_counter() - t0) * 1e9),
+                            explain=explain)
                     return results, stats
                 finally:
                     self.serving.release(ticket, ok=ok)
@@ -1518,11 +1592,21 @@ class Broker:
             # they are counted under px_serving_shed_total instead
             shed = True
             raise
-        except Exception:
+        except Exception as e:
             _metrics.counter_inc("px_broker_query_errors_total",
                                  help_="ExecuteScript requests that failed")
+            if trace.enabled():
+                # failed queries are profile rows too (status=error): an
+                # error budget burning down must be visible in the same
+                # table the latency dashboards read
+                prow, _ops = _observe.build_profile(
+                    qid or self._query_trace_id(), tenant, "broker",
+                    t0_unix_ns, int((_time.perf_counter() - t0) * 1e9), {},
+                    status="error", error=str(e))
+                self._telemetry.add(_observe.PROFILES_TABLE, [prow])
             raise
         finally:
+            latency_s = _time.perf_counter() - t0
             if not shed:
                 # sheds stay out of the latency SLO histogram: a flood of
                 # sub-ms rejections (or 30s queue-timeout sheds) during
@@ -1530,15 +1614,54 @@ class Broker:
                 # actually EXECUTED — exactly when the SLO signal matters
                 _metrics.histogram_observe(
                     "px_broker_query_latency_seconds",
-                    _time.perf_counter() - t0, QUERY_LATENCY_BOUNDS,
+                    latency_s, QUERY_LATENCY_BOUNDS,
                     help_="broker end-to-end ExecuteScript latency "
                           "(executed queries; sheds excluded)")
+            # the serving front's SLO loop eats every outcome — completed,
+            # failed, AND shed (a shed is a client-visible availability
+            # failure; hiding it from the burn rate would defeat the alert)
+            _slo.record_query(tenant, latency_s, ok_query)
+            if _slo.configured():
+                mon = _slo.monitor()
+                mon.maybe_evaluate()
+                self._telemetry.add(_observe.ALERTS_TABLE,
+                                    mon.drain_alerts())
             if owns_root:
                 self._ship_spans()
+
+    def _query_trace_id(self) -> str:
+        """Query id for profile rows: the active trace root's trace_id (so
+        profiles JOIN against self_telemetry.spans), else a fresh token."""
+        c = trace.current()
+        if c is not None:
+            return c[1].trace_id
+        import secrets as _secrets
+
+        return _secrets.token_hex(16)
+
+    def _record_profile(self, qid, stats: dict, tenant: str,
+                        t0_unix_ns: int, wall_ns: int,
+                        explain: bool) -> None:
+        """Assemble this query's flight-recorder profile from its stats and
+        attach it (stats["profile"], stats["explain"]); recording into the
+        data plane only happens with tracing enabled."""
+        from pixie_tpu import observe as _observe
+
+        profile, op_rows = _observe.build_profile(
+            qid or self._query_trace_id(), tenant, "broker", t0_unix_ns,
+            wall_ns, stats)
+        stats["profile"] = profile
+        if explain:
+            stats["explain"] = _observe.render_explain(
+                profile, op_rows, plan_text=stats.pop("plan_explain", None))
+        if trace.enabled():
+            self._telemetry.add(_observe.PROFILES_TABLE, [profile])
+            self._telemetry.add(_observe.OP_STATS_TABLE, op_rows)
 
     def _execute_script_inner(
         self, script, func, func_args, now, default_limit, analyze,
         funcs=None, tenant: str = DEFAULT_TENANT, ticket=None,
+        explain: bool = False,
     ) -> tuple[dict[str, QueryResult], dict]:
         import time as _time
 
@@ -1579,6 +1702,7 @@ class Broker:
         sink_map = None
         entry = None
         plan_cache_hit = False
+        t_compile0 = _time.perf_counter_ns()
         if funcs:
             # multi-widget fusion stays on the slow path: its sink_map and
             # per-widget arg sets make the cache key explode for no warm win
@@ -1601,6 +1725,12 @@ class Broker:
             key = self.plan_cache.key(script, func, func_args, default_limit,
                                       ("reg", topo_epoch), tenant=tenant)
             q, entry, plan_cache_hit = self.plan_cache.get_query(key, _compile)
+        compile_ns = _time.perf_counter_ns() - t_compile0
+        plan_text = None
+        if explain:
+            from pixie_tpu.plan.debug import explain as _plan_explain
+
+            plan_text = _plan_explain(q.plan)
         if q.mutations:
             # Deploy tracepoints to every live agent and wait for readiness
             # (reference MutationExecutor: register → agents deploy → poll
@@ -1620,10 +1750,22 @@ class Broker:
             got = self._maybe_batched(q, key, spec, topo_epoch, failover,
                                       tenant, ticket)
             if got is not None:
-                return got
+                results, stats = got
+                if plan_text is not None or trace.enabled():
+                    # a batched member's profile carries ITS OWN compile
+                    # time and logical plan (the fused plan is the
+                    # leader's implementation detail) beside the fused
+                    # run's measured phases + batch slot
+                    stats = dict(stats)
+                    stats["phases"] = dict(stats.get("phases") or {},
+                                           compile_ns=compile_ns)
+                    if plan_text is not None:
+                        stats["plan_explain"] = plan_text
+                return results, stats
         return self._run_distributed(
             q, entry, spec, topo_epoch, failover, analyze, tenant, ticket,
-            plan_cache_hit, sink_map=sink_map)
+            plan_cache_hit, sink_map=sink_map, compile_ns=compile_ns,
+            plan_text=plan_text)
 
     # ------------------------------------------------------ query batching
     def _maybe_batched(self, q, key, spec, topo_epoch, failover, tenant,
@@ -1726,6 +1868,7 @@ class Broker:
     def _run_distributed(
         self, q, entry, spec, topo_epoch, failover, analyze, tenant,
         ticket, plan_cache_hit, sink_map=None, extra_verify=None,
+        compile_ns: int = 0, plan_text=None,
     ) -> tuple[dict[str, QueryResult], dict]:
         """Split (cached per topology epoch), dispatch to agents with the
         fault-tolerant machinery, fold/merge, run the merger plan, and
@@ -1762,8 +1905,13 @@ class Broker:
 
         from pixie_tpu.engine.plancache import QueryPlanCache as _QPC
 
+        #: flight-recorder phase anchors (observe.build_profile): one
+        #: perf_counter read per phase boundary — cheap enough to measure
+        #: unconditionally; the dict only ships when profiles are on
+        t_split0 = _time.perf_counter_ns()
         (dp, split_extras), split_hit = _QPC.get_split(
             entry, ("split", topo_epoch), _split)
+        split_ns = _time.perf_counter_ns() - t_split0
 
         reg = self.udf_registry
         if reg is None:
@@ -1817,6 +1965,7 @@ class Broker:
         fault = {"rounds": 0, "evictions": 0, "hedged": 0,
                  "chunks_discarded": 0, "redispatched": []}
         retries = int(_flags.get("PL_QUERY_RETRIES"))
+        t_exec0 = _time.perf_counter_ns()
         try:
             for agent_name in dp.agent_plans:
                 pj = (split_extras["plan_json"].get(agent_name)
@@ -1846,6 +1995,7 @@ class Broker:
                     if (k := plan_view_key(plan, reg)) is not None
                 }
 
+            t_merge0 = _time.perf_counter_ns()
             with trace.span("merge"):
                 from pixie_tpu.parallel.repartition import (
                     bucket_channels,
@@ -2032,6 +2182,18 @@ class Broker:
                     stats["merger"]["operators"] = ex.op_stats
                 for r in results.values():
                     r.exec_stats["agents"] = ctx.agent_stats
+            if trace.enabled() or plan_text is not None:
+                # where the time went, measured at the phase seams the
+                # spans already mark — observe.build_profile sums these
+                # into the per-query attribution row
+                stats["phases"] = {
+                    "compile_ns": int(compile_ns),
+                    "plan_split_ns": int(split_ns),
+                    "exec_ns": int(t_merge0 - t_exec0),
+                    "merge_ns": int(_time.perf_counter_ns() - t_merge0),
+                }
+                if plan_text is not None:
+                    stats["plan_explain"] = plan_text
             return results, stats
         finally:
             # span hygiene: a timeout / disconnect / error leaves dispatch

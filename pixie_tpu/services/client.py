@@ -158,7 +158,7 @@ class Client:
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False, funcs=None,
-        tenant: Optional[str] = None,
+        tenant: Optional[str] = None, explain: bool = False,
     ) -> dict[str, QueryResult]:
         """funcs=[(prefix, func_name, func_args)] runs a multi-widget
         request as ONE fused broker query; results key by fused sink name,
@@ -177,7 +177,7 @@ class Client:
                 out = self._execute_once(
                     script, func=func, func_args=func_args, now=now,
                     default_limit=default_limit, analyze=analyze,
-                    funcs=funcs, tenant=tenant)
+                    funcs=funcs, tenant=tenant, explain=explain)
                 self.last_retries = attempt
                 if attempt:
                     from pixie_tpu import metrics as _metrics
@@ -215,7 +215,7 @@ class Client:
     def _execute_once(
         self, script: str, func=None, func_args=None, now=None,
         default_limit=None, analyze: bool = False, funcs=None,
-        tenant: Optional[str] = None,
+        tenant: Optional[str] = None, explain: bool = False,
     ) -> dict[str, QueryResult]:
         rid, p = self._new_pending()
         try:
@@ -230,6 +230,7 @@ class Client:
                 "msg": "execute_script", "req_id": rid, "script": script,
                 "func": func, "func_args": func_args, "now": now,
                 "default_limit": default_limit, "analyze": analyze,
+                "explain": explain,
                 "funcs": [list(f) for f in funcs] if funcs else None,
                 "tenant": tenant if tenant is not None else self.tenant,
             }))

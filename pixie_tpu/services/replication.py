@@ -185,6 +185,21 @@ class ReplicaStore:
                 for name, t in self._data.get(primary, {}).items()
             }
         store = TableStore()
+        # the engine-owned self-telemetry tables (spans, query profiles,
+        # op stats, metrics, alerts) exist on every agent by construction,
+        # so the dead primary's registered schema advertises them; their
+        # sealed batches rarely replicate (telemetry churns below the seal
+        # threshold).  Create them EMPTY so a distributed scan of
+        # self_telemetry.* stays answerable through failover — the replica
+        # serves an empty shard for the dead primary instead of erroring
+        # the whole query.
+        from pixie_tpu import observe, trace
+
+        if trace.SPANS_TABLE not in tabs:
+            trace.ensure_table(store)
+        for tname in observe.SELF_TABLES:
+            if tname not in tabs:
+                observe.ensure_table(store, tname)
         for name, (rel, batch_rows, max_bytes, batches) in tabs.items():
             tb = store.create(name, Relation.from_dict(rel),
                               batch_rows=batch_rows, max_bytes=max_bytes)

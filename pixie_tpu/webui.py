@@ -124,7 +124,44 @@ _INDEX = """<!doctype html>
 <html><head><meta charset="utf-8"><title>pixie-tpu live</title>
 <style>body { font: 14px system-ui; margin: 24px; background: #101418; color: #e4e8ec; }
 a { color: #6cb6ff; text-decoration: none; display: inline-block; width: 240px; padding: 3px 0; }</style>
-</head><body><h2>pixie-tpu live — scripts</h2>%s</body></html>"""
+</head><body><h2>pixie-tpu live — scripts</h2>
+<p><a href="/profiles">query profiles (flight recorder)</a></p>
+%s</body></html>"""
+
+#: the query-profile panel (GET /profiles): the flight recorder's own
+#: tables rendered server-side — recent per-query rows with their fast-path
+#: provenance, per-tenant latency, and SLO alert edges, all read from
+#: self_telemetry.* through the normal query path (pixie_tpu.observe)
+_PROFILES_SCRIPT = """
+df = px.DataFrame(table='self_telemetry.query_profiles')
+df = df[['time_', 'query_id', 'tenant', 'service', 'status', 'wall_ns',
+         'exec_ns', 'rows_scanned', 'plan_cache_hit', 'matview_hits',
+         'matview_stale', 'batch_size', 'hedged', 'evictions']]
+df = df.head(50)
+px.display(df, '1 recent query profiles')
+lat = px.DataFrame(table='self_telemetry.query_profiles')
+lat = lat.groupby(['tenant', 'status']).agg(
+    queries=('wall_ns', px.count),
+    latency_p50=('wall_ns', px.p50),
+    latency_p99=('wall_ns', px.p99),
+)
+px.display(lat, '2 per-tenant latency')
+al = px.DataFrame(table='self_telemetry.alerts')
+al = al.groupby(['slo', 'tenant', 'window', 'state']).agg(
+    edges=('burn_rate', px.count),
+    max_burn=('burn_rate', px.max),
+)
+px.display(al, '3 slo alert edges')
+"""
+
+_PROFILES_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>query profiles — pixie-tpu</title>
+<style>body { font: 14px system-ui; margin: 24px; background: #101418; color: #e4e8ec; }
+table { border-collapse: collapse; margin: 8px 0 20px; }
+td, th { border: 1px solid #2a3038; padding: 3px 8px; font: 12px ui-monospace, monospace; }
+th { background: #1a2028; } a { color: #6cb6ff; }</style>
+</head><body><h2>query profiles (flight recorder)</h2>
+<p><a href="/">&larr; scripts</a></p>%s</body></html>"""
 
 
 def _esc(v) -> str:
@@ -372,6 +409,8 @@ class LiveServer:
                 parsed = urllib.parse.urlparse(self.path)
                 if parsed.path in ("", "/"):
                     return self._send(outer.index_page())
+                if parsed.path == "/profiles":
+                    return self._send(outer.profiles_page())
                 if parsed.path.startswith("/script/"):
                     name = parsed.path[len("/script/"):]
                     qs = dict(urllib.parse.parse_qsl(parsed.query))
@@ -433,6 +472,21 @@ class LiveServer:
             f'<a href="/script/{n}">{_esc(n)}</a>' for n in self._script_names()
         )
         return _INDEX % links
+
+    def profiles_page(self) -> str:
+        """Server-rendered query-profile panel over the flight recorder's
+        self_telemetry tables (empty tables render as a note, not a 500 —
+        a fresh deployment has no profiles yet)."""
+        try:
+            results, _ = self.runner(_PROFILES_SCRIPT, None)
+            body = "".join(
+                f"<h3>{_esc(name)}</h3>" + table_html(res, max_rows=50)
+                for name, res in sorted(results.items()))
+        except Exception as e:
+            body = ("<p>no profiles yet — run a query with tracing on "
+                    f"(PL_TRACING_ENABLED) first. ({_esc(type(e).__name__)}: "
+                    f"{_esc(e)})</p>")
+        return _PROFILES_PAGE % body
 
     def _load(self, name: str):
         # script names are single bundle-dir components; anything with path

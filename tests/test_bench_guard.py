@@ -486,3 +486,47 @@ def test_serving_load_batched_harness_crash_trips_floors():
     assert "configs.serving_load.batched_speedup" in keys
     assert all(r.get("missing") for r in regs
                if r["key"].startswith("configs.serving_load.batched"))
+
+
+def _observe_doc(rows=200_000, frac=0.021, **extra):
+    return {
+        "rows": 64_000_000,
+        "configs": {
+            "observe_overhead": {
+                "rows": rows, "on_p50_ms": 2.0, "off_p50_ms": 1.96,
+                "overhead_frac": frac, "samples_per_arm": 48, **extra,
+            },
+        },
+    }
+
+
+def test_observe_overhead_absolute_ceiling():
+    """The flight recorder's instrumentation tax is guarded ABSOLUTELY:
+    overhead_frac (warm p50 with tracing+profiles+SLO on vs
+    PL_TRACING_ENABLED=0) above 5% fails the round."""
+    assert bench.absolute_floors(_observe_doc()) == []
+    regs = bench.absolute_floors(_observe_doc(frac=0.08))
+    assert [r["key"] for r in regs] == [
+        "configs.observe_overhead.overhead_frac"]
+    assert regs[0]["ceiling"] == 0.05 and regs[0]["now"] == 0.08
+    assert "above ceiling" in bench._format_regression(regs[0])
+    # a ceiling violation fails compare_bench too (the CI entry point)
+    assert bench.compare_bench(_observe_doc(), _observe_doc(frac=0.2),
+                               threshold=0.15)
+    # a different shape never trips the 200k-row bound
+    assert bench.absolute_floors(_observe_doc(rows=50_000, frac=0.5)) == []
+
+
+def test_observe_overhead_harness_crash_fails_guard():
+    """A crashed observe_overhead harness (error marker, overhead_frac
+    missing at the guarded shape) FAILS the ceiling instead of silently
+    disabling the gate."""
+    doc = _observe_doc()
+    node = doc["configs"]["observe_overhead"]
+    del node["overhead_frac"], node["on_p50_ms"], node["off_p50_ms"]
+    node["error"] = "RuntimeError: boom"
+    regs = bench.absolute_floors(doc)
+    assert [r["key"] for r in regs] == [
+        "configs.observe_overhead.overhead_frac"]
+    assert regs[0].get("missing")
+    assert "missing at guarded shape" in bench._format_regression(regs[0])

@@ -96,3 +96,46 @@ def test_broker_metrics_endpoint():
         client.close()
         agent.stop()
         broker.stop()
+
+
+def test_hist_quantile_interpolates_bucket_counts():
+    from pixie_tpu import metrics
+
+    name = "px_test_hq_seconds"
+    bounds = (0.1, 0.2, 0.4, 0.8)
+    # 10 obs in (0.1, 0.2], 10 in (0.2, 0.4]
+    for _ in range(10):
+        metrics.histogram_observe(name, 0.15, bounds, help_="t")
+        metrics.histogram_observe(name, 0.3, bounds)
+    # p50 = exactly the boundary between the two buckets
+    assert metrics.hist_quantile(name, 0.5) == pytest.approx(0.2)
+    # p25 interpolates inside the first occupied bucket
+    assert 0.1 < metrics.hist_quantile(name, 0.25) < 0.2
+    # p100 clamps to the covering bucket's bound
+    assert metrics.hist_quantile(name, 1.0) == pytest.approx(0.4)
+    # unknown series / empty series read as None, not 0
+    assert metrics.hist_quantile("px_never_observed", 0.5) is None
+    with pytest.raises(ValueError):
+        metrics.hist_quantile(name, 1.5)
+
+
+def test_hist_quantile_overflow_clamps_to_last_bound():
+    from pixie_tpu import metrics
+
+    name = "px_test_hq_overflow"
+    metrics.histogram_observe(name, 99.0, (0.1, 1.0), help_="t")
+    assert metrics.hist_quantile(name, 0.99) == pytest.approx(1.0)
+
+
+def test_metrics_snapshot_rows_for_sampler():
+    from pixie_tpu import metrics
+
+    metrics.counter_inc("px_test_snap_total", 2.0, help_="t")
+    metrics.gauge_set("px_test_snap_gauge", 7.0, help_="t")
+    metrics.histogram_observe("px_test_snap_hist", 0.5, (0.25, 1.0),
+                              help_="t")
+    rows = {(k, n): v for k, n, _l, v in metrics.snapshot()}
+    assert rows[("counter", "px_test_snap_total")] == 2.0
+    assert rows[("gauge", "px_test_snap_gauge")] == 7.0
+    assert rows[("hist_count", "px_test_snap_hist")] == 1.0
+    assert ("hist_p99", "px_test_snap_hist") in rows

@@ -214,3 +214,81 @@ def test_cli_reports_violations(tmp_path):
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 1
     assert "env-read" in r.stdout
+
+
+# ------------------------------------------------------------- pxl-columns
+
+
+def _lint_pxl(tmp_path, src: str, dirname: str = "self_x"):
+    d = tmp_path / dirname
+    d.mkdir()
+    (d / "x.pxl").write_text(textwrap.dedent(src))
+    return pxlint.lint_pxl_scripts([str(tmp_path)])
+
+
+def test_pxl_columns_catches_schema_drift(tmp_path):
+    fs = _lint_pxl(tmp_path, """
+        import px
+
+        def f():
+            df = px.DataFrame(table='self_telemetry.spans')
+            df = df[df.bogus_col == 'x']
+            df = df.groupby(['service', 'nope']).agg(
+                c=('missing', px.count))
+            return df
+    """)
+    msgs = [f.msg for f in fs]
+    assert _rules(fs) == ["pxl-columns"] * 3
+    assert any("bogus_col" in m for m in msgs)
+    assert any("nope" in m for m in msgs)
+    assert any("missing" in m for m in msgs)
+
+
+def test_pxl_columns_unknown_table(tmp_path):
+    fs = _lint_pxl(tmp_path, """
+        import px
+
+        def f():
+            df = px.DataFrame(table='not_a_real_table')
+            return df
+    """)
+    assert _rules(fs) == ["pxl-columns"]
+    assert "not_a_real_table" in fs[0].msg
+
+
+def test_pxl_columns_tracks_derived_and_agg_output_columns(tmp_path):
+    # map-assigned columns, agg outputs, and groupby keys all become part
+    # of the frame; chaining over them must NOT false-positive
+    fs = _lint_pxl(tmp_path, """
+        import px
+
+        def f():
+            df = px.DataFrame(table='self_telemetry.query_profiles')
+            df.slow = df.wall_ns / 1000000
+            df = df[df.slow > 5]
+            df = df.groupby('tenant').agg(avg=('slow', px.mean))
+            df = df.groupby('tenant').agg(mx=('avg', px.max))
+            df = df[['tenant', 'mx']]
+            return df
+    """)
+    assert fs == []
+
+
+def test_pxl_columns_only_lints_self_bundle_dirs(tmp_path):
+    # a non-self_* bundle dir is out of the rule's scope (the reference
+    # bundle's scripts are not ours to gate)
+    fs = _lint_pxl(tmp_path, """
+        import px
+
+        def f():
+            df = px.DataFrame(table='nope_table')
+            return df
+    """, dirname="http_data")
+    assert fs == []
+
+
+def test_shipped_self_scripts_stay_clean():
+    """The ratchet stays at zero findings for the shipped self-telemetry
+    dashboards (schema drift between collect/schemas.py and the bundled
+    scripts fails here first)."""
+    assert pxlint.lint_pxl_scripts() == []

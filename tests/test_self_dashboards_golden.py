@@ -1,0 +1,196 @@
+"""Golden-VALUE execution parity for the repo-bundled px/self_metrics and
+px/self_slo dashboards (the test_self_query_latency_golden pattern applied
+to the flight recorder's tables): a pandas oracle independently recomputes
+each vis func over the same telemetry rows, and the engine's output must
+match value-for-value.  Quantiles (px.p50/px.p99 log-histogram sketch)
+compare with a relative tolerance; counts/sums/maxes must match exactly."""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from pixie_tpu import observe
+from pixie_tpu.collect.schemas import all_schemas
+from pixie_tpu.compiler import compile_pxl
+from pixie_tpu.engine import execute_plan
+from pixie_tpu.scripts import REPO_BUNDLE
+from pixie_tpu.table import TableStore
+from tests.test_script_golden import assert_frames
+
+SEC = 1_000_000_000
+
+
+def _metric_rows() -> list[dict]:
+    rng = np.random.default_rng(11)
+    rows = []
+    i = 0
+    for service in ("broker", "pem0"):
+        for name, kind in (("px_broker_queries_total", "counter"),
+                           ("px_serving_inflight", "gauge"),
+                           ("px_slo_burn_rate", "gauge"),
+                           ("px_broker_query_latency_seconds", "hist_p50")):
+            for _ in range(int(rng.integers(4, 9))):
+                labels = ("" if name != "px_slo_burn_rate"
+                          else json.dumps({"slo": "lat",
+                                           "tenant": f"t{i % 2}"}))
+                rows.append({
+                    "time_": 100 * SEC + i, "service": service,
+                    "name": name, "labels": labels, "kind": kind,
+                    "value": round(float(rng.uniform(0, 100)), 3),
+                })
+                i += 1
+    return rows
+
+
+def _profile_rows() -> list[dict]:
+    rng = np.random.default_rng(12)
+    rows = []
+    for i in range(160):
+        rows.append({
+            "time_": 100 * SEC + i,
+            "query_id": f"{i:032x}",
+            "tenant": f"tenant{i % 3}",
+            "service": "broker",
+            "status": "ok" if i % 7 else "error",
+            "wall_ns": int(rng.integers(10_000, 50_000_000)),
+            "plan_cache_hit": int(i % 2),
+            "matview_hits": int(i % 3),
+            "matview_stale": int(i % 5 == 0),
+            "batch_size": int(i % 4),
+            "hedged": int(i % 11 == 0),
+            "evictions": int(i % 13 == 0),
+        })
+    return rows
+
+
+def _alert_rows() -> list[dict]:
+    rows = []
+    for i in range(24):
+        rows.append({
+            "time_": 100 * SEC + i,
+            "slo": "lat" if i % 2 else "avail",
+            "tenant": f"tenant{i % 3}",
+            "window": "fast" if i % 4 < 2 else "slow",
+            "burn_rate": round(6.0 + i * 0.5, 2),
+            "threshold": 14.4 if i % 4 < 2 else 6.0,
+            "objective": 0.99,
+            "state": "firing" if i % 3 else "resolved",
+        })
+    return rows
+
+
+@pytest.fixture(scope="module")
+def store():
+    ts = TableStore()
+    observe.write_rows(ts, observe.METRICS_TABLE, _metric_rows())
+    observe.write_rows(ts, observe.PROFILES_TABLE, _profile_rows())
+    observe.write_rows(ts, observe.ALERTS_TABLE, _alert_rows())
+    return ts
+
+
+def _run(store, script: str, func: str):
+    src = (REPO_BUNDLE / script / f"{script}.pxl").read_text()
+    q = compile_pxl(src, all_schemas(), func=func, func_args={})
+    results = execute_plan(q.plan, store)
+    assert len(results) == 1, sorted(results)
+    return next(iter(results.values()))
+
+
+def _q(groupby, q: float):
+    # rank-based quantile matching the engine's log-histogram semantics
+    return groupby.apply(lambda s: np.quantile(
+        np.asarray(s, dtype=np.float64), q, method="inverted_cdf"))
+
+
+# ------------------------------------------------------------- self_metrics
+
+
+def test_metric_summary_golden(store):
+    res = _run(store, "self_metrics", "metric_summary")
+    df = pd.DataFrame(_metric_rows())
+    exp = df.groupby(["service", "name", "kind"], as_index=False).agg(
+        samples=("value", "count"),
+        avg_value=("value", "mean"),
+        max_value=("value", "max"))
+    assert_frames(res, exp, approx=("avg_value",), rtol=1e-9)
+
+
+def test_counter_peaks_golden(store):
+    res = _run(store, "self_metrics", "counter_peaks")
+    df = pd.DataFrame(_metric_rows())
+    df = df[df["kind"] == "counter"]
+    exp = df.groupby(["service", "name"], as_index=False).agg(
+        samples=("value", "count"), total=("value", "max"))
+    assert_frames(res, exp)
+
+
+def test_burn_rates_golden(store):
+    res = _run(store, "self_metrics", "burn_rates")
+    df = pd.DataFrame(_metric_rows())
+    df = df[df["name"] == "px_slo_burn_rate"]
+    exp = df.groupby(["service", "labels"], as_index=False).agg(
+        samples=("value", "count"),
+        max_burn=("value", "max"),
+        avg_burn=("value", "mean"))
+    assert_frames(res, exp, approx=("avg_burn",), rtol=1e-9)
+
+
+# ----------------------------------------------------------------- self_slo
+
+
+def test_tenant_latency_golden(store):
+    res = _run(store, "self_slo", "tenant_latency")
+    df = pd.DataFrame(_profile_rows())
+    exp = df.groupby("tenant", as_index=False).agg(
+        queries=("wall_ns", "count"))
+    dur = df.groupby("tenant")["wall_ns"]
+    exp["latency_p50"] = np.floor(_q(dur, 0.5).to_numpy())
+    exp["latency_p99"] = np.floor(_q(dur, 0.99).to_numpy())
+    assert_frames(res, exp, approx=("latency_p50", "latency_p99"),
+                  rtol=0.05)
+
+
+def test_tenant_errors_golden(store):
+    res = _run(store, "self_slo", "tenant_errors")
+    df = pd.DataFrame(_profile_rows())
+    exp = df.groupby(["tenant", "status"], as_index=False).agg(
+        queries=("wall_ns", "count"))
+    assert_frames(res, exp)
+
+
+def test_fastpath_hits_golden(store):
+    res = _run(store, "self_slo", "fastpath_hits")
+    df = pd.DataFrame(_profile_rows())
+    exp = df.groupby("tenant", as_index=False).agg(
+        queries=("wall_ns", "count"),
+        plan_cache_hits=("plan_cache_hit", "sum"),
+        matview_hits=("matview_hits", "sum"),
+        stale_serves=("matview_stale", "sum"),
+        batched=("batch_size", "sum"),
+        hedged=("hedged", "sum"),
+        evictions=("evictions", "sum"))
+    assert_frames(res, exp)
+
+
+def test_slo_alerts_golden(store):
+    res = _run(store, "self_slo", "slo_alerts")
+    df = pd.DataFrame(_alert_rows())
+    exp = df.groupby(["slo", "tenant", "window", "state"],
+                     as_index=False).agg(
+        edges=("burn_rate", "count"),
+        max_burn=("burn_rate", "max"))
+    assert_frames(res, exp)
+
+
+def test_vis_json_widgets_cover_every_func():
+    for name in ("self_metrics", "self_slo"):
+        import ast
+
+        src = (REPO_BUNDLE / name / f"{name}.pxl").read_text()
+        funcs = {n.name for n in ast.parse(src).body
+                 if isinstance(n, ast.FunctionDef)}
+        vis = json.loads((REPO_BUNDLE / name / "vis.json").read_text())
+        assert {w["func"]["name"] for w in vis["widgets"]} == funcs
