@@ -610,6 +610,29 @@ def bench_serving_load(clients, duration_s=8.0, rows=100_000):
     return got
 
 
+def bench_elastic_ramp(clients_high, rows=60_000):
+    """`elastic_ramp`: the closed-loop elasticity proof (ROADMAP item 4) —
+    a diurnal traffic curve (low → high → low closed-loop clients) against
+    a real broker+agent deployment with the AgentSupervisor live and one
+    injected preemption (faultinject `kill:` pod loss on a spawned agent).
+    The guard block holds ABSOLUTELY: agent-count tracks load (scale_ups
+    ≥ 1 AND scale_downs ≥ 1), fairness ≤ 2.0 across the interactive
+    tenants, zero client-visible errors, bit-equal results throughout the
+    topology churn, a preemption actually fired, and the interactive p99
+    bounded."""
+    from pixie_tpu.serving.elastic_bench import run_elastic_ramp
+
+    try:
+        out = run_elastic_ramp(clients_high=clients_high, rows=rows)
+    except Exception as e:  # the bench round must survive a harness failure
+        return {"rows": clients_high, "error": f"{type(e).__name__}: {e}"[:200]}
+    keep = ("rows", "duration_s", "queries", "goodput_qps", "p50_ms",
+            "p99_ms", "fairness_ratio", "shed_rate", "client_errors",
+            "bit_equal_frac", "scale_ups", "scale_downs", "preemptions",
+            "agents_start", "agents_peak", "agents_final")
+    return {k: out[k] for k in keep if k in out}
+
+
 #: observe_overhead's warm dashboard script (the interactive shape the
 #: flight recorder instruments on every query)
 OBSERVE_SCRIPT = """
@@ -913,6 +936,8 @@ def main():
                     help="concurrent closed-loop clients for serving_load")
     ap.add_argument("--chaos-queries", type=int, default=80,
                     help="replayed queries for the chaos_recovery config")
+    ap.add_argument("--elastic-clients", type=int, default=16,
+                    help="high-phase closed-loop clients for elastic_ramp")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
     ap.add_argument("--quick", action="store_true", help="small-but-real shapes")
     ap.add_argument("--repeats", type=int, default=3)
@@ -935,6 +960,7 @@ def main():
         args.stream_rows, args.join_rows, args.dist_rows = 400_000, 200_000, 200_000
         args.serving_clients = 60
         args.chaos_queries = 16
+        args.elastic_clients = 10
     elif args.quick:
         args.rows, args.sweep = 4_000_000, "1000000,4000000"
         args.stream_rows, args.join_rows, args.dist_rows = (
@@ -942,6 +968,7 @@ def main():
         )
         args.serving_clients = 160
         args.chaos_queries = 40
+        args.elastic_clients = 12
 
     from pixie_tpu.table import TableStore
 
@@ -992,6 +1019,7 @@ def main():
     observe_oh = bench_observe_overhead()
     chaos = bench_chaos_recovery(args.chaos_queries)
     chaos_hard = bench_chaos_recovery_hard(max(args.chaos_queries // 2, 12))
+    elastic = bench_elastic_ramp(args.elastic_clients)
     sharded = bench_sharded_agg(args.rows, args.repeats)
     cfg3, cfg3_busy = bench_config3(args.join_rows, args.repeats)
     dj_rows = min(args.join_rows, 16_000_000)
@@ -1033,6 +1061,7 @@ def main():
             "observe_overhead": observe_oh,
             "chaos_recovery": chaos,
             "chaos_recovery_hard": chaos_hard,
+            "elastic_ramp": elastic,
             "sharded_agg_64m": sharded,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
@@ -1302,6 +1331,14 @@ ABS_FLOORS = [
     ("configs.chaos_recovery_hard.wipe_kills", 1.0, 40),
     ("configs.chaos_recovery_hard.journal_replayed_rows", 1.0, 40),
     ("configs.chaos_recovery_hard.repl_rehydrated_rows", 1.0, 40),
+    # closed-loop elasticity acceptance (ROADMAP item 4): under the diurnal
+    # ramp the fleet must actually have scaled BOTH ways, a preemption must
+    # actually have fired, and every answer under the topology churn must
+    # be bit-equal to the fixed-fleet baseline
+    ("configs.elastic_ramp.scale_ups", 1.0, 16),
+    ("configs.elastic_ramp.scale_downs", 1.0, 16),
+    ("configs.elastic_ramp.preemptions", 1.0, 16),
+    ("configs.elastic_ramp.bit_equal_frac", 1.0, 16),
 ]
 
 #: absolute ceilings (key path, ceiling, shape rows) — the serving
@@ -1330,6 +1367,12 @@ ABS_CEILINGS = [
     # p50 vs PL_TRACING_ENABLED=0, measured in interleaved blocks every
     # round (the same shape at every bench mode — always guarded)
     ("configs.observe_overhead.overhead_frac", 0.05, 200_000),
+    # elasticity acceptance: fair shares held across the whole curve, zero
+    # client-visible errors through scale-ups/downs/preemption, and the
+    # interactive tail bounded (queueing + spawn + recovery, never a stall)
+    ("configs.elastic_ramp.fairness_ratio", 2.0, 16),
+    ("configs.elastic_ramp.client_errors", 0.0, 16),
+    ("configs.elastic_ramp.p99_ms", 20_000.0, 16),
 ]
 
 

@@ -370,6 +370,60 @@ def cmd_live(args) -> int:
     return main_live(_make_runner(args), args.bundle)
 
 
+def _fmt_quota(tenant: str, q: dict) -> str:
+    def lim(v):
+        return "unlimited" if not v else f"{v:g}"
+
+    src = "live" if q.get("live") else "env-default"
+    return (f"{tenant:<24} qps={lim(q.get('qps')):<10} "
+            f"concurrency={lim(q.get('concurrency')):<10} "
+            f"weight={q.get('weight', 1.0):<6g} [{src}]")
+
+
+def cmd_quota(args) -> int:
+    """Live tenant quota control plane: `quota set` writes a per-tenant
+    record the broker applies to its scheduler immediately and persists in
+    its KV (survives restart; the PL_TENANT_* env specs stay the
+    defaults); `quota show` dumps effective quotas + the measured
+    service-rate model."""
+    from pixie_tpu.services.client import Client, QueryError
+
+    host, port = args.broker.rsplit(":", 1)
+    client = Client(host, int(port), auth_token=args.auth_token)
+    try:
+        if args.quota_cmd == "set":
+            if args.clear:
+                eff = client.clear_quota(args.tenant)
+            else:
+                if (args.qps is None and args.concurrency is None
+                        and args.weight is None):
+                    raise SystemExit(
+                        "quota set: give at least one of --qps/"
+                        "--concurrency/--weight (or --clear)")
+                eff = client.set_quota(args.tenant, qps=args.qps,
+                                       concurrency=args.concurrency,
+                                       weight=args.weight)
+            print(_fmt_quota(args.tenant, eff))
+        else:
+            got = client.get_quotas()
+            tenants = got.get("tenants") or {}
+            if not tenants:
+                print("no active tenants or live quota records")
+            for tenant in sorted(tenants):
+                print(_fmt_quota(tenant, tenants[tenant]))
+            rm = got.get("rate_model") or {}
+            if rm:
+                print(f"-- measured rates: cold_cost={rm.get('cost_cold')} "
+                      f"arrival_qps={rm.get('arrival_qps')} "
+                      f"warm_p50_ms={(rm.get('warm') or {}).get('p50_ms')} "
+                      f"cold_p50_ms={(rm.get('cold') or {}).get('p50_ms')}")
+    except QueryError as e:
+        raise SystemExit(f"quota: {e}") from None
+    finally:
+        client.close()
+    return 0
+
+
 def cmd_agent(args) -> int:
     from pixie_tpu.services.agent import main as agent_main
 
@@ -443,6 +497,28 @@ def main(argv=None) -> int:
     lv.add_argument("--auth-token", default=None)
     lv.add_argument("--tenant", default=None)
     lv.set_defaults(fn=cmd_live)
+
+    qt = sub.add_parser("quota", help="live tenant quotas (set | show)")
+    qsub = qt.add_subparsers(dest="quota_cmd", required=True)
+    qs = qsub.add_parser("set", help="write one tenant's live quota record")
+    qs.add_argument("tenant")
+    qs.add_argument("--broker", required=True, help="host:port")
+    qs.add_argument("--qps", type=float, default=None,
+                    help="token-bucket rate (0 = unlimited; omit = keep "
+                         "the env-spec default)")
+    qs.add_argument("--concurrency", type=int, default=None,
+                    help="in-flight cap (0 = unlimited; omit = env default)")
+    qs.add_argument("--weight", type=float, default=None,
+                    help="DRR share (> 0; omit = env default)")
+    qs.add_argument("--clear", action="store_true",
+                    help="drop the live record (back to env-spec defaults)")
+    qs.add_argument("--auth-token", default=None)
+    qs.set_defaults(fn=cmd_quota)
+    qw = qsub.add_parser("show",
+                         help="effective quotas + measured service rates")
+    qw.add_argument("--broker", required=True, help="host:port")
+    qw.add_argument("--auth-token", default=None)
+    qw.set_defaults(fn=cmd_quota)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)

@@ -61,6 +61,7 @@ PROFILES_TABLE = "self_telemetry.query_profiles"
 OP_STATS_TABLE = "self_telemetry.op_stats"
 METRICS_TABLE = "self_telemetry.metrics"
 ALERTS_TABLE = "self_telemetry.alerts"
+SCALE_EVENTS_TABLE = "self_telemetry.scale_events"
 
 PROFILES_RELATION = Relation.of(
     ("time_", DT.TIME64NS, ST.ST_TIME_NS),
@@ -129,11 +130,25 @@ ALERTS_RELATION = Relation.of(
     ("state", DT.STRING),
 )
 
+#: autoscaler control-loop decisions (serving/elastic.py): every spawn,
+#: retire, hand-off and refused retire lands here with the smoothed
+#: pressure that drove it and the live agent count after it — the fleet's
+#: own sizing history is queryable like any other telemetry
+SCALE_EVENTS_RELATION = Relation.of(
+    ("time_", DT.TIME64NS, ST.ST_TIME_NS),
+    ("action", DT.STRING),
+    ("agent", DT.STRING),
+    ("reason", DT.STRING),
+    ("pressure", DT.FLOAT64),
+    ("agents", DT.INT64),
+)
+
 SELF_TABLES: dict[str, Relation] = {
     PROFILES_TABLE: PROFILES_RELATION,
     OP_STATS_TABLE: OP_STATS_RELATION,
     METRICS_TABLE: METRICS_RELATION,
     ALERTS_TABLE: ALERTS_RELATION,
+    SCALE_EVENTS_TABLE: SCALE_EVENTS_RELATION,
 }
 
 

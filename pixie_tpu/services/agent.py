@@ -297,6 +297,16 @@ class Agent:
                 sem.release()
         elif msg == "reregister":
             self._register()
+        elif msg == "retire_query":
+            # scale-down drain audit (broker.retire_agent) — OFF the read
+            # loop: wait_synced may block up to its budget, and stalling
+            # this loop would freeze chunk_ack/execute/shard_map handling
+            # for every in-flight query on a still-serving retire candidate
+            threading.Thread(
+                target=self._answer_retire_query,
+                args=(payload.get("req_id"),), daemon=True,
+                name=f"pixie-agent-retire-{self.name}",
+            ).start()
         elif msg == "peers":
             # reply to a get_peers RPC (rehydration topology fetch)
             with self._replies_lock:
@@ -351,6 +361,27 @@ class Agent:
                     "qtoken": payload.get("qtoken"),
                     "agent": self.name, "error": str(e),
                 }))
+
+    def _answer_retire_query(self, req_id) -> None:
+        """Report the rows this agent holds outside the self-telemetry
+        tables (the data a retire would lose) and whether the replication
+        stream has synced them onto the peers — the broker's loss-safety
+        input (broker.retire_agent)."""
+        rows = 0
+        for n in self.store.names():
+            if n.startswith("self_telemetry."):
+                continue
+            try:
+                rows += int(self.store.table(n).stats()
+                            .get("rows_written", 0))
+            except Exception:
+                rows = -1  # unauditable: the broker refuses the retire
+                break
+        synced = (self.replication is not None
+                  and self.replication.wait_synced(0.5))
+        self.conn.send(wire.encode_json({
+            "msg": "retire_info", "req_id": req_id,
+            "agent": self.name, "rows": rows, "repl_synced": synced}))
 
     def _execute(self, meta: dict):
         import contextlib

@@ -434,6 +434,18 @@ class Broker:
         #: returns its slot on completion.  PL_SERVING_ENABLED=0 makes it
         #: a pass-through.
         self.serving = ServingFront("broker")
+        #: measured per-(tenant, plan-class) service-rate model
+        #: (serving/ratemodel.py): fed from every completion, it replaces
+        #: the static warm/cold DRR costs and the heuristic retry-after
+        #: with measured rates, and drives the autoscaler's demand signal
+        from pixie_tpu.serving.ratemodel import ServiceRateModel
+
+        self.ratemodel = ServiceRateModel()
+        self.serving.rate_model = self.ratemodel
+        #: broker-driven agent autoscaler (serving/elastic.py), armed in
+        #: start() when PL_AUTOSCALE=1 (benches/tests may pre-assign one
+        #: with their own launcher before start())
+        self.supervisor = None
         #: self-telemetry spans for the query path; shipped to an agent's
         #: spans table at query end (the broker holds no scanned store)
         self.tracer = trace.Tracer("broker")
@@ -457,6 +469,9 @@ class Broker:
         self._agent_conns: dict[str, Connection] = {}
         self._queries: dict[str, _QueryCtx] = {}
         self._qlock = threading.Lock()
+        #: broker→agent control RPC slots (retire drain audits):
+        #: req_id -> [Event, reply payload]
+        self._control_replies: dict[str, list] = {}
         #: per-agent service-time model for straggler hedging: EWMA of
         #: dispatch→exec_done seconds + EWMA of |deviation| (a cheap p99
         #: estimate: ewma + 4*dev); warmed by HEDGE_MIN_SAMPLES before a
@@ -487,6 +502,10 @@ class Broker:
                 )[0],
                 kv=self.kv,
             )
+            # live tenant quotas persisted by the control plane: recall
+            # them into the serving front so quota writes survive broker
+            # restart (the PL_TENANT_* env specs stay the defaults)
+            self._load_quotas()
             #: optional LeaderElector (services/election.py): when set, this
             #: broker only serves queries while holding the lease — a standby
             #: broker sharing the KV takes over when the leader dies
@@ -542,9 +561,20 @@ class Broker:
         )
         trace.register_gauges()
         self.serving.attach_gauges()
+        self.ratemodel.attach_gauges()
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
+        from pixie_tpu.serving import elastic as _elastic  # PL_AUTOSCALE_*
+
+        if _flags.get("PL_AUTOSCALE") and self.supervisor is None:
+            # standalone broker (cli): the default launcher spawns real
+            # agent subprocesses against this broker's port; harnesses
+            # pre-assign a supervisor with their own launcher instead
+            self.supervisor = _elastic.AgentSupervisor(
+                self, _elastic.ProcLauncher("127.0.0.1", self.port))
+        if self.supervisor is not None:
+            self.supervisor.start()
         period = float(_flags.get("PL_SELF_METRICS_S"))
         if period > 0:
             from pixie_tpu.services.cron import Ticker
@@ -564,6 +594,8 @@ class Broker:
         from pixie_tpu import metrics as _metrics
 
         self._stopped.set()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         self.cron.stop()
         if self._self_metrics is not None:
             self._self_metrics.stop()
@@ -574,6 +606,7 @@ class Broker:
             self.elector.stop()
         self._server.stop()
         self.serving.detach_gauges()
+        self.ratemodel.detach_gauges()
         _metrics.unregister_gauge_fn("px_broker_live_agents")
         self.kv.close()
 
@@ -699,17 +732,46 @@ class Broker:
                         for c in self.cron.list()
                     ],
                 }))
+            elif msg == "set_quota":
+                self._handle_set_quota(conn, payload)
+            elif msg == "get_quotas":
+                conn.send(wire.encode_json({
+                    "msg": "quotas", "req_id": payload.get("req_id"),
+                    "quotas": self.serving.quotas(),
+                    "rate_model": self.ratemodel.snapshot(),
+                }))
+            elif msg == "retire_info":
+                # reply to a broker→agent retire drain audit (retire_agent)
+                with self._qlock:
+                    slot = self._control_replies.get(payload.get("req_id"))
+                if slot is not None:
+                    slot[1] = payload
+                    slot[0].set()
             elif msg == "deregister_agent":
                 # operator decommission: drop the durable record so the
                 # shard map stops treating the retired node as a failover
-                # primary (and catch-up degradation clears)
-                ok = self.registry.deregister(str(payload.get("agent")))
-                conn.send(wire.encode_json({
-                    "msg": "ok" if ok else "error",
-                    "req_id": payload.get("req_id"),
-                    **({} if ok else {"error": "unknown agent"})}))
-                if ok:
-                    self._push_shard_map()
+                # primary (and catch-up degradation clears).  Refused when
+                # the shard map says this agent is the LAST live holder of
+                # any shard (its own, or a dead primary's it alone serves
+                # failover for) — deregistering it would lose that shard
+                # from every future plan; force=true overrides.
+                name = str(payload.get("agent"))
+                sole = ([] if payload.get("force")
+                        else self._sole_holder_of(name))
+                if sole:
+                    conn.send(wire.encode_json({
+                        "msg": "error", "req_id": payload.get("req_id"),
+                        "error": f"agent {name} is the last live holder of "
+                                 f"shard(s) {sole}; deregistering it would "
+                                 "lose them (force=true overrides)"}))
+                else:
+                    ok = self.registry.deregister(name)
+                    conn.send(wire.encode_json({
+                        "msg": "ok" if ok else "error",
+                        "req_id": payload.get("req_id"),
+                        **({} if ok else {"error": "unknown agent"})}))
+                    if ok:
+                        self._push_shard_map()
             elif msg == "get_peers":
                 # pre-registration topology fetch: a rehydrating agent asks
                 # who backs its shard (and where their replication ports
@@ -869,6 +931,202 @@ class Broker:
         if not extra:
             return spec
         return ClusterSpec(spec.agents[:-1] + extra + spec.agents[-1:])
+
+    # ---------------------------------------------------------- quota control
+    def _handle_set_quota(self, conn: Connection, payload: dict) -> None:
+        """Live tenant quota write: validate (malformed specs are REJECTED
+        with a clean error — this is an interactive API, not an env var),
+        apply to the serving front in place, persist in the KV so the
+        record survives broker restart."""
+        from pixie_tpu.serving.admission import normalize_quota
+        from pixie_tpu.status import InvalidArgument
+
+        rid = payload.get("req_id")
+        tenant = payload.get("tenant")
+        try:
+            rec = normalize_quota(tenant, payload.get("qps"),
+                                  payload.get("concurrency"),
+                                  payload.get("weight"))
+        except InvalidArgument as e:
+            conn.send(wire.encode_json(
+                {"msg": "error", "req_id": rid, "error": str(e)}))
+            return
+        try:
+            eff = self.serving.set_quota(tenant, rec)
+        except PxError as e:  # e.g. the live-record cap: a clean reject
+            conn.send(wire.encode_json(
+                {"msg": "error", "req_id": rid, "error": str(e)}))
+            return
+        if all(v is None for v in rec.values()):
+            self.kv.delete(f"quota/{tenant}")
+        else:
+            self.kv.set_json(f"quota/{tenant}", rec)
+        conn.send(wire.encode_json({
+            "msg": "quota_ok", "req_id": rid, "tenant": tenant,
+            "effective": eff}))
+
+    def _load_quotas(self) -> None:
+        """Recall persisted quota records into the serving front (broker
+        restart).  A corrupt record is skipped (counted), never fatal."""
+        from pixie_tpu import metrics as _metrics
+        from pixie_tpu.serving.admission import normalize_quota
+
+        for key, raw in self.kv.scan("quota/"):
+            tenant = key[len("quota/"):]
+            try:
+                d = _json.loads(raw.decode())
+                rec = normalize_quota(tenant, d.get("qps"),
+                                      d.get("concurrency"), d.get("weight"))
+            except Exception:
+                _metrics.counter_inc(
+                    "px_broker_quota_recall_errors_total",
+                    help_="persisted quota records skipped at broker "
+                          "startup (corrupt or no longer valid)")
+                continue
+            self.serving.set_quota(tenant, rec)
+
+    # ------------------------------------------------------------ agent retire
+    def _sole_holder_of(self, name: str) -> list[str]:
+        """Primaries whose ONLY live holder is `name` per the PR 12 shard
+        map: the shard coverage retiring `name` would lose.  Empty with
+        replication off (no map) — the retire path then relies on the
+        drain audit (rows held) instead."""
+        m = self.registry.shard_map()
+        live = {r.name for r in self.registry.live_agents()}
+        out = []
+        for p, reps in m.items():
+            holders = (({p} if p in live else set())
+                       | {r for r in (reps or []) if r in live})
+            if holders == {name}:
+                out.append(p)
+        return sorted(out)
+
+    def _agent_rpc(self, name: str, meta: dict, timeout: float = 5.0) -> dict:
+        """One broker→agent control round-trip on the agent's connection."""
+        conn = self._agent_conns.get(name)
+        if conn is None or conn.closed:
+            raise TimeoutError(f"agent {name} not connected")
+        with self._qlock:
+            self._req_counter += 1
+            rid = f"ctl{self._req_counter}"
+            slot = [threading.Event(), None]
+            self._control_replies[rid] = slot
+        try:
+            meta = dict(meta, req_id=rid)
+            if not conn.send(wire.encode_json(meta)):
+                raise TimeoutError(f"agent {name} not connected")
+            if not slot[0].wait(timeout):
+                raise TimeoutError(
+                    f"agent {name} did not answer {meta.get('msg')}")
+            return slot[1]
+        finally:
+            with self._qlock:
+                self._control_replies.pop(rid, None)
+
+    def retire_agent(self, name: str, force: bool = False) -> dict:
+        """Scale-down decommission with loss safety (the autoscaler's
+        retire path; serving/elastic.py).  Protocol:
+
+          1. Shard-map check FIRST: an agent that is the last live holder
+             of any shard (its own primary data, or a dead primary it
+             alone serves failover for) is refused — deregistering it
+             would lose rows from every future answer.
+          2. Drain audit: the agent reports the rows it holds outside the
+             self-telemetry tables (`retire_query` RPC) and whether its
+             replication stream is synced.
+          3. rows == 0 → deregister + shard-map push (a clean retire: the
+             agent held nothing irreplaceable).
+             rows > 0 with replication synced onto a live replica → the
+             PR 12 hand-off: the agent stops but its durable record STAYS,
+             so its shard keeps answering through broker failover from the
+             replicated sealed batches.
+             rows > 0 otherwise → REFUSED (retiring it would lose rows).
+
+        Returns {ok, mode: deregister|handoff|None, rows, reason}."""
+        from pixie_tpu import metrics as _metrics
+
+        rec = self.registry.record(name)
+        if rec is None:
+            return {"ok": False, "mode": None, "rows": None,
+                    "reason": "unknown agent"}
+        sole = self._sole_holder_of(name)
+        if sole and not force:
+            _metrics.counter_inc(
+                "px_autoscale_retire_refused_total",
+                help_="scale-down retires refused by the loss-safety audit "
+                      "(last live shard holder, unauditable rows, or "
+                      "unsynced replication)")
+            return {"ok": False, "mode": None, "rows": None,
+                    "reason": f"last live holder of shard(s) {sole}"}
+        rows = None
+        repl_synced = False
+        try:
+            reply = self._agent_rpc(name, {"msg": "retire_query"},
+                                    timeout=5.0)
+            rows = int(reply.get("rows", -1))
+            repl_synced = bool(reply.get("repl_synced"))
+        except TimeoutError:
+            pass
+        if rows is None or rows < 0:
+            if not force:
+                _metrics.counter_inc(
+                    "px_autoscale_retire_refused_total",
+                    help_="scale-down retires refused by the loss-safety "
+                          "audit (last live shard holder, unauditable "
+                          "rows, or unsynced replication)")
+                return {"ok": False, "mode": None, "rows": rows,
+                        "reason": "drain audit unanswered"}
+            rows = -1
+        if rows > 0 and not force:
+            reps = self.registry.shard_map().get(name) or []
+            live = {r.name for r in self.registry.live_agents()}
+            if not (_replication.enabled() and repl_synced
+                    and any(r in live for r in reps)):
+                _metrics.counter_inc(
+                    "px_autoscale_retire_refused_total",
+                    help_="scale-down retires refused by the loss-safety "
+                          "audit (last live shard holder, unauditable "
+                          "rows, or unsynced replication)")
+                return {"ok": False, "mode": None, "rows": rows,
+                        "reason": "holds rows with no synced live replica"}
+            # PR 12 hand-off: keep the durable record — the shard keeps
+            # serving through failover from the replicated sealed batches
+            # once the agent stops (the supervisor owns the stop)
+            return {"ok": True, "mode": "handoff", "rows": rows,
+                    "reason": ""}
+        self.registry.deregister(name)
+        self._push_shard_map()
+        return {"ok": True, "mode": "deregister", "rows": rows,
+                "reason": ""}
+
+    def reap_dead_agent(self, name: str) -> bool:
+        """Deregister a DEAD supervisor-owned agent (preemption cleanup) —
+        refused when the shard map still needs it (it may hold the only
+        replicated copy of a shard some peer will rehydrate from)."""
+        rec = self.registry.record(name)
+        if rec is None or rec.alive or self._sole_holder_of(name):
+            return False
+        self.registry.deregister(name)
+        self._push_shard_map()
+        return True
+
+    def record_scale_event(self, action: str, agent: str, reason: str,
+                           pressure: float, agents: int) -> None:
+        """One autoscaler decision into self_telemetry.scale_events (the
+        supervisor's journal, shipped with the normal telemetry path)."""
+        import time as _time
+
+        from pixie_tpu import observe as _observe
+
+        self._telemetry.add(_observe.SCALE_EVENTS_TABLE, [{
+            "time_": _time.time_ns(),
+            "action": str(action),
+            "agent": str(agent),
+            "reason": str(reason or ""),
+            "pressure": round(float(pressure), 4),
+            "agents": int(agents),
+        }])
+        self._ship_spans()
 
     # ---------------------------------------------------------------- handlers
     def _handle_register(self, conn: Connection, meta: dict):
@@ -1303,10 +1561,23 @@ class Broker:
                         f"agent {names[0]} disconnected mid-query")
                     if not q.mutations:
                         # infrastructure loss, not a query bug: the client
-                        # may retry once the agent re-registers
+                        # may retry once the agent re-registers.  The hint
+                        # composes BOTH waits the retry faces: the backoff
+                        # schedule covering the agent's rejoin window (the
+                        # drain rate says nothing about when lost DATA
+                        # comes back — a bare drain hint of 0.05s on an
+                        # idle queue would burn every client retry inside
+                        # the rejoin grace) and, when the rate model is
+                        # warm, the measured time for the queued work
+                        # ahead of the retry to drain.
                         err.retryable = True
-                        err.retry_after_s = min(
-                            backoff_ms * (2 ** rounds), MAX_BACKOFF_MS) / 1e3
+                        hint = self.ratemodel.retry_after_s(
+                            self.serving.total_queued,
+                            int(_flags.get("PL_SERVING_MAX_INFLIGHT")))
+                        err.retry_after_s = max(
+                            min(backoff_ms * (2 ** rounds),
+                                MAX_BACKOFF_MS) / 1e3,
+                            hint or 0.0)
                     raise err
                 rounds += 1
                 fault["rounds"] = rounds
@@ -1493,26 +1764,40 @@ class Broker:
         Cost estimate: a plan-cache peek decides warm (dispatch+merge only)
         vs cold (full compile/split) — the same signal the DRR scheduler
         charges, so a tenant flooding cold compiles drains proportionally
-        slower.  Raises ShedError (quota/queue-full/timeout/overload);
-        returns the Ticket to release, or None when serving is disabled.
-        """
+        slower.  The cold price is the MEASURED cold/warm service-time
+        ratio once the rate model has samples (PL_RATE_MODEL), the static
+        COST_COLD until then.  Raises ShedError (quota/queue-full/timeout/
+        overload); returns (ticket, plan_class) — ticket None when serving
+        is disabled (the class still feeds the model)."""
+        from pixie_tpu.serving import ratemodel as _rm
+
         trace.set_attr(tenant=tenant)
-        if not self.serving.enabled():
-            return None
         from pixie_tpu.engine import plancache as _plancache
 
+        # mutations classify apart (deploy round-trips must skew neither
+        # service class) — the same lexical marker the client's no-retry
+        # rule uses; everything else prices off the plan-cache peek
+        mutation = ("UpsertTracepoint" in script
+                    or "DeleteTracepoint" in script)
         if not _plancache.enabled():
             # PL_QUERY_FASTPATH=0: no warm/cold signal exists and every
             # query pays the same full compile — price uniformly WARM so
             # DRR stays fair by count and the overload shed (which drops
             # cost >= COST_COLD work) cannot turn degradation into a full
             # outage
-            cost = COST_WARM
+            warm = True
         else:
             key = self.plan_cache.key(script, func, func_args, default_limit,
                                       ("reg", self.registry.epoch),
                                       tenant=tenant)
-            cost = COST_WARM if self.plan_cache.contains(key) else COST_COLD
+            warm = self.plan_cache.contains(key)
+        cls = _rm.plan_class(warm, mutation=mutation)
+        self.ratemodel.observe_arrival(tenant, cls)
+        if not self.serving.enabled():
+            return None, cls  # pass-through: no accounting, no queueing
+        cost = (COST_WARM if warm
+                else self.ratemodel.cost_of(False) if _rm.enabled()
+                else COST_COLD)
         with trace.span("admission_wait", tenant=tenant, cost=cost):
             ticket = self.serving.admit(tenant, cost)
         if ticket.queued:
@@ -1521,7 +1806,7 @@ class Broker:
             trace.event_span("sched_dispatch", ticket.enqueue_ns,
                              ticket.wait_ns, tenant=tenant, cost=cost,
                              degraded=ticket.degraded)
-        return ticket
+        return ticket, cls
 
     def execute_script(
         self, script: str, func=None, func_args=None, now=None,
@@ -1563,14 +1848,17 @@ class Broker:
         shed = False
         ok_query = False
         qid = None
+        cls = None  # rate-model plan class, set once admission classifies
+        wait_ns = 0
         try:
             with trace.maybe_root(self.tracer, "query"):
                 # captured while the trace root is live: the except block
                 # below runs AFTER the cm unwinds, and an error profile
                 # must still join this query's spans on query_id==trace_id
                 qid = self._query_trace_id() if prof_on else None
-                ticket = self._admit(script, func, func_args, default_limit,
-                                     tenant)
+                ticket, cls = self._admit(script, func, func_args,
+                                          default_limit, tenant)
+                wait_ns = ticket.wait_ns if ticket is not None else 0
                 ok = False
                 try:
                     results, stats = self._execute_script_inner(
@@ -1621,6 +1909,12 @@ class Broker:
             # failed, AND shed (a shed is a client-visible availability
             # failure; hiding it from the burn rate would defeat the alert)
             _slo.record_query(tenant, latency_s, ok_query)
+            # the rate model eats SERVICE time only (queue wait excluded —
+            # it measures how fast the engine serves, not the line length);
+            # sheds never executed, so they feed arrival counts only
+            if cls is not None and not shed:
+                self.ratemodel.observe(tenant, cls,
+                                       latency_s - wait_ns / 1e9, ok_query)
             if _slo.configured():
                 mon = _slo.monitor()
                 mon.maybe_evaluate()

@@ -66,6 +66,7 @@ class _Pending:
         self.chunks: list = []
         self.stats: dict = {}
         self.schemas: Optional[dict] = None
+        self.reply: Optional[dict] = None
         self.error: Optional[str] = None
         self.retry_after_s: Optional[float] = None
         self.retryable: bool = False
@@ -130,6 +131,9 @@ class Client:
             p.done.set()
         elif msg == "schemas":
             p.schemas = meta["schemas"]
+            p.done.set()
+        elif msg in ("quota_ok", "quotas"):
+            p.reply = meta
             p.done.set()
         elif msg == "error":
             p.error = meta.get("error", "unknown error")
@@ -254,6 +258,48 @@ class Client:
                     dictionaries=hb.dicts, exec_stats=dict(p.stats),
                 )
             return out
+        finally:
+            with self._lock:
+                self._pending.pop(rid, None)
+
+    # ------------------------------------------------------------ control plane
+    def set_quota(self, tenant: str, qps=None, concurrency=None,
+                  weight=None) -> dict:
+        """Write one tenant's LIVE quota record (broker control plane):
+        fields left None keep the PL_TENANT_* env-spec default for that
+        field; qps/concurrency 0 = explicitly unlimited.  The broker
+        validates (malformed specs raise QueryError), applies it to the
+        scheduler in place, and persists it in its KV — the record
+        survives broker restart.  Returns the tenant's effective quotas."""
+        reply = self._control_rpc({
+            "msg": "set_quota", "tenant": tenant, "qps": qps,
+            "concurrency": concurrency, "weight": weight})
+        return reply.get("effective") or {}
+
+    def clear_quota(self, tenant: str) -> dict:
+        """Drop a tenant's live quota record (back to env-spec defaults)."""
+        reply = self._control_rpc({"msg": "set_quota", "tenant": tenant})
+        return reply.get("effective") or {}
+
+    def get_quotas(self) -> dict:
+        """{tenants: {tenant: effective quota}, rate_model: snapshot} —
+        the control plane's read side."""
+        reply = self._control_rpc({"msg": "get_quotas"})
+        return {"tenants": reply.get("quotas") or {},
+                "rate_model": reply.get("rate_model") or {}}
+
+    def _control_rpc(self, meta: dict) -> dict:
+        rid, p = self._new_pending()
+        try:
+            self._ensure_conn()
+            if not self.conn.send(wire.encode_json(dict(meta, req_id=rid))):
+                raise Unavailable("broker connection closed")
+            if not p.done.wait(timeout=self.timeout_s):
+                raise Unavailable(
+                    f"{meta.get('msg')} timed out after {self.timeout_s}s")
+            if p.error:
+                raise QueryError(p.error)
+            return p.reply or {}
         finally:
             with self._lock:
                 self._pending.pop(rid, None)

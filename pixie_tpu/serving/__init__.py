@@ -19,6 +19,21 @@ users:
                   mixed-tenant clients against a real broker+agents
                   deployment, reporting p50/p99, goodput, shed rate and
                   per-tenant fairness (the `serving_load` bench config)
+  ratemodel.py  — measured per-(tenant, plan-class) service-rate model:
+                  replaces the static warm/cold DRR costs and heuristic
+                  retry-after with measured rates, and supplies the
+                  autoscaler's Little's-law demand signal (PL_RATE_MODEL)
+  elastic.py    — AgentSupervisor: broker-driven agent autoscaling with
+                  hysteresis/cooldowns/bounds, loss-safe retires, and
+                  orphan-proof launchers (PL_AUTOSCALE)
+  elastic_bench.py — diurnal-ramp elasticity proof (the `elastic_ramp`
+                  bench config: scale both ways under injected
+                  preemption, bit-equal throughout)
+
+Live quotas: `ServingFront.set_quota` applies control-plane records
+(`admission.normalize_quota`) ahead of the PL_TENANT_* env specs; the
+broker persists them in its KV and exposes `set_quota`/`get_quotas`
+frames (CLI `quota set|show`).
 
 Flag-off (`PL_SERVING_ENABLED=0`) the front is a pass-through: no
 accounting, no queueing, bit-identical results.
@@ -28,6 +43,7 @@ from pixie_tpu.serving.admission import (
     COST_WARM,
     ShedError,
     TokenBucket,
+    normalize_quota,
     parse_tenant_spec,
 )
 from pixie_tpu.serving.scheduler import ServingFront, Ticket
@@ -39,5 +55,6 @@ __all__ = [
     "ShedError",
     "Ticket",
     "TokenBucket",
+    "normalize_quota",
     "parse_tenant_spec",
 ]

@@ -114,6 +114,53 @@ def spec_value(raw: str, tenant: str, cast=float):
     return overrides.get(tenant, default)
 
 
+#: live-quota weight clamp (same bound _TenantState applies to env-spec
+#: weights: the DRR round budget is O(cost/min_weight) under the lock)
+WEIGHT_MIN, WEIGHT_MAX = 0.01, 100.0
+
+
+def normalize_quota(tenant, qps=None, concurrency=None, weight=None) -> dict:
+    """Validate one live quota record (the control-plane write path —
+    broker `set_quota` frames and the CLI).  Unlike `parse_tenant_spec`
+    (an ops ENV surface, where a typo must degrade, not crash the broker),
+    a malformed API write is REJECTED with a clean error: the caller is
+    interactive and must learn its spec was wrong.
+
+    Field semantics: None = no override (the PL_TENANT_* env spec stays
+    the default for that field); 0 = explicitly unlimited (qps /
+    concurrency only); weight must be positive when given.  Returns the
+    normalized record {qps, concurrency, weight}."""
+    from pixie_tpu.status import InvalidArgument
+
+    if not isinstance(tenant, str) or not tenant.strip():
+        raise InvalidArgument("quota: tenant must be a non-empty string")
+
+    def num(name, v, cast, allow_zero):
+        if v is None or v == "":
+            return None
+        if isinstance(v, bool):
+            raise InvalidArgument(f"quota: {name} must be a number")
+        try:
+            v = cast(v)
+        except (TypeError, ValueError):
+            raise InvalidArgument(
+                f"quota: {name} must be a number, got {v!r}") from None
+        if v < 0 or (v == 0 and not allow_zero):
+            raise InvalidArgument(
+                f"quota: {name} must be {'>= 0' if allow_zero else '> 0'}, "
+                f"got {v!r}")
+        return v
+
+    w = num("weight", weight, float, allow_zero=False)
+    if w is not None:
+        w = min(max(w, WEIGHT_MIN), WEIGHT_MAX)
+    return {
+        "qps": num("qps", qps, float, allow_zero=True),
+        "concurrency": num("concurrency", concurrency, int, allow_zero=True),
+        "weight": w,
+    }
+
+
 class TokenBucket:
     """Classic token bucket: `rate` tokens/s refill, `capacity` burst.
 

@@ -1,0 +1,299 @@
+"""Diurnal-ramp elasticity harness (the `elastic_ramp` bench config).
+
+The closed-loop proof of ROADMAP item 4: a real broker + agent deployment
+under a diurnal traffic curve (low → high → low closed-loop client
+counts) with the AgentSupervisor live and ≥ 1 injected preemption
+(faultinject ``kill:`` rule firing a true pod loss on a spawned agent),
+must hold — all measured from the run, all guarded absolutely by
+``bench.py --check-regressions``:
+
+  * **agent-count tracks load** — ≥ 1 scale-up during the high phase and
+    ≥ 1 scale-down after it (`scale_ups` / `scale_downs`), with the
+    per-phase live-agent counts reported (`agents_start/peak/final`).
+  * **bit-equal results throughout** — every query's answer is BIT-equal
+    to its fixed-fleet baseline while the topology changes underneath it
+    (spawned agents join every plan as empty schema-matched shards; the
+    preempted agent's loss re-dispatches; retires deregister mid-load).
+  * **zero client-visible errors** — sheds with retry-after are flow
+    control; anything else is a failure.
+  * **fairness ≤ 2.0** — max/min goodput across the three interactive
+    tenants over the HIGH phase (the one span in which every tenant
+    fields the same client count; low phases run a client subset, so a
+    whole-curve ratio would measure the phase schedule, not the
+    scheduler).
+  * **interactive p99 bounded** — the ramp (queueing, spawning,
+    preemption recovery) costs bounded tail latency.
+
+Spawned agents carry the serving tables' SCHEMAS with ZERO rows: they join
+the distributed plan (the topology-change correctness risk this bench
+exists to pin) without perturbing a single result bit, and retire through
+the drain audit as clean (row-free) deregisters.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from pixie_tpu.services.chaos_bench import SCRIPTS, _mkstore, canonical_bytes
+from pixie_tpu.serving.load_bench import _hist_pcts
+
+#: flags the harness overrides and restores
+_FLAGS = (
+    "PL_SERVING_ENABLED", "PL_SERVING_MAX_INFLIGHT",
+    "PL_SERVING_QUEUE_DEPTH", "PL_SERVING_QUEUE_TIMEOUT_S",
+    "PL_SERVING_SHED_WATERMARK", "PL_QUERY_RETRIES", "PL_CLIENT_RETRIES",
+    "PL_RETRY_BACKOFF_MS", "PL_REJOIN_GRACE_S", "PL_RATE_MODEL",
+    "PL_AUTOSCALE",
+    "PL_AUTOSCALE_MIN", "PL_AUTOSCALE_MAX", "PL_AUTOSCALE_UP_WATERMARK",
+    "PL_AUTOSCALE_DOWN_WATERMARK", "PL_AUTOSCALE_UP_COOLDOWN_S",
+    "PL_AUTOSCALE_DOWN_COOLDOWN_S", "PL_AUTOSCALE_PERIOD_S",
+    "PL_AUTOSCALE_EWMA",
+)
+
+
+class _Counts:
+    __slots__ = ("ok", "shed", "errors", "mismatch", "lat")
+
+    def __init__(self):
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+        self.mismatch = 0
+        self.lat: list[float] = []
+
+
+def run_elastic_ramp(clients_high: int = 16, clients_low: int = 3,
+                     phase_s: tuple = (3.0, 7.0, 6.0), rows: int = 60_000,
+                     n_seed: int = 2, max_agents: int = 5,
+                     conns: int = 6, interactive_tenants: int = 3) -> dict:
+    """Drive the diurnal ramp; returns the elastic_ramp result dict."""
+    import pixie_tpu.serving.ratemodel  # noqa: F401 — defines PL_RATE_MODEL
+    import pixie_tpu.serving.elastic  # noqa: F401 — defines PL_AUTOSCALE_*
+
+    from pixie_tpu import flags, metrics
+    from pixie_tpu.services import faultinject
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client, QueryError
+    from pixie_tpu.serving.elastic import AgentSupervisor, ThreadLauncher
+
+    saved = {n: flags.get(n) for n in _FLAGS}
+    # capacity is deliberately SMALLER than the high-phase client count so
+    # measured pressure crosses the up watermark; the low phases sit well
+    # under the down watermark so the fleet contracts again
+    flags.set_for_testing("PL_SERVING_ENABLED", True)
+    flags.set_for_testing("PL_SERVING_MAX_INFLIGHT", 6)
+    flags.set_for_testing("PL_SERVING_QUEUE_DEPTH", 4 * clients_high)
+    flags.set_for_testing("PL_SERVING_QUEUE_TIMEOUT_S", 60.0)
+    flags.set_for_testing("PL_SERVING_SHED_WATERMARK", 8 * clients_high)
+    flags.set_for_testing("PL_QUERY_RETRIES", 6)
+    flags.set_for_testing("PL_CLIENT_RETRIES", 6)
+    flags.set_for_testing("PL_RETRY_BACKOFF_MS", 100)
+    # a preempted SPAWNED agent never self-restarts (the supervisor owns
+    # its lifecycle and replaces it with a fresh name), so a long rejoin
+    # grace would only stall the kill's in-flight queries — shorten it
+    flags.set_for_testing("PL_REJOIN_GRACE_S", 0.3)
+    flags.set_for_testing("PL_RATE_MODEL", True)
+    flags.set_for_testing("PL_AUTOSCALE", True)
+    flags.set_for_testing("PL_AUTOSCALE_MIN", n_seed)
+    flags.set_for_testing("PL_AUTOSCALE_MAX", max_agents)
+    flags.set_for_testing("PL_AUTOSCALE_UP_WATERMARK", 0.9)
+    flags.set_for_testing("PL_AUTOSCALE_DOWN_WATERMARK", 0.45)
+    flags.set_for_testing("PL_AUTOSCALE_UP_COOLDOWN_S", 1.0)
+    flags.set_for_testing("PL_AUTOSCALE_DOWN_COOLDOWN_S", 1.5)
+    flags.set_for_testing("PL_AUTOSCALE_PERIOD_S", 0.15)
+    flags.set_for_testing("PL_AUTOSCALE_EWMA", 0.4)
+
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0)
+    # spawned agents: schema-matched EMPTY shards (join every plan, change
+    # no result bit, retire as clean deregisters)
+    broker.supervisor = AgentSupervisor(
+        broker, ThreadLauncher("127.0.0.1", broker.port,
+                               store_factory=lambda _n: _mkstore(0, 0),
+                               heartbeat_s=0.5))
+    broker.start()
+    sup = broker.supervisor
+    stores = {f"pem{i}": _mkstore(i + 1, rows) for i in range(n_seed)}
+    agents = {n: Agent(n, "127.0.0.1", broker.port, store=st,
+                       heartbeat_s=0.5).start() for n, st in stores.items()}
+    pool = [Client("127.0.0.1", broker.port, timeout_s=90.0)
+            for _ in range(conns)]
+    itenants = [f"tenant{i}" for i in range(interactive_tenants)]
+
+    preempt0 = metrics.counter_value("px_autoscale_preempted_total")
+    stop = threading.Event()
+    target = [clients_low]
+    agents_seen: list[int] = []
+    preempts_fired = [0]
+
+    try:
+        # fixed-fleet baseline fingerprints (and model/plan-cache warmth)
+        baseline = []
+        for s in SCRIPTS:
+            for t in itenants:
+                pool[0].execute_script(s, tenant=t)
+            baseline.append(canonical_bytes(pool[0].execute_script(s)))
+
+        # fairness is judged over the HIGH phase only — the one span in
+        # which every tenant fields the same client count (low phases run
+        # a subset of clients, so whole-run goodput ratios would measure
+        # the phase schedule, not the scheduler)
+        per_tenant = {t: _Counts() for t in itenants}
+        high_tenant = {t: _Counts() for t in itenants}
+        phase_idx = [0]
+
+        def client_loop(idx: int):
+            tenant = itenants[idx % len(itenants)]
+            conn = pool[idx % len(pool)]
+            it = 0
+            while not stop.is_set():
+                if idx >= target[0]:
+                    stop.wait(0.05)
+                    continue
+                res = (high_tenant if phase_idx[0] == 1
+                       else per_tenant)[tenant]
+                # rotate scripts per iteration so every tenant pays the
+                # same script mix (a fixed per-client script would make
+                # the fairness ratio measure script cost)
+                si = (idx + it) % len(SCRIPTS)
+                it += 1
+                t0 = time.perf_counter()
+                try:
+                    got = conn.execute_script(SCRIPTS[si], tenant=tenant)
+                    res.lat.append(time.perf_counter() - t0)
+                    if canonical_bytes(got) != baseline[si]:
+                        res.mismatch += 1
+                    res.ok += 1
+                except QueryError as e:
+                    if e.retry_after_s is not None:
+                        res.shed += 1
+                        stop.wait(min(e.retry_after_s, 1.0))
+                    else:
+                        res.errors += 1
+                except Exception:
+                    res.errors += 1
+
+        def preempt_spawned():
+            """Inject ONE true pod loss on a supervisor-spawned agent the
+            moment one is live (the spot/maintenance event scale-up must
+            absorb).  The kill: rule drops the victim's store and RSTs on
+            its next outbound frame."""
+            deadline = time.monotonic() + phase_s[1]
+            while not stop.is_set() and time.monotonic() < deadline:
+                for name in sup.spawned_agents():
+                    rec = broker.registry.record(name)
+                    if rec is not None and rec.alive:
+                        faultinject.install(f"kill:agent:{name}@send=1")
+                        preempts_fired[0] += 1
+                        return
+                stop.wait(0.1)
+
+        threads = [threading.Thread(target=client_loop, args=(i,),
+                                    daemon=True)
+                   for i in range(clients_high)]
+        for th in threads:
+            th.start()
+        t_start = time.monotonic()
+        # ---- the diurnal curve: low → high (+ preemption) → low ----------
+        phases = [(phase_s[0], clients_low), (phase_s[1], clients_high),
+                  (phase_s[2], clients_low)]
+        killer = None
+        for i, (dur, n) in enumerate(phases):
+            phase_idx[0] = i
+            target[0] = n
+            if i == 1:
+                killer = threading.Thread(target=preempt_spawned,
+                                          daemon=True)
+                killer.start()
+            end = time.monotonic() + dur
+            while time.monotonic() < end:
+                time.sleep(0.25)
+                agents_seen.append(len(broker.registry.live_agents()))
+        measured_s = time.monotonic() - t_start
+        stop.set()
+        for th in threads:
+            th.join(timeout=30.0)
+        if killer is not None:
+            killer.join(timeout=5.0)
+        agents_final = len(broker.registry.live_agents())
+        scale_ups, scale_downs = sup.scale_ups, sup.scale_downs
+        retire_refused = sup.retire_refusals
+        preempted = metrics.counter_value(
+            "px_autoscale_preempted_total") - preempt0
+    except Exception:
+        raise
+    finally:
+        faultinject.uninstall()
+        for c in pool:
+            c.close()
+        broker.stop()  # stops the supervisor (and its spawned agents) too
+        for a in agents.values():
+            try:
+                a.stop()
+            except Exception:
+                pass
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+
+    # fold the high-phase counts into the whole-run totals (they were kept
+    # apart only so fairness could be judged on the balanced span)
+    high_s = phase_s[1]
+    for t, r in high_tenant.items():
+        per_tenant[t].ok += r.ok
+        per_tenant[t].shed += r.shed
+        per_tenant[t].errors += r.errors
+        per_tenant[t].mismatch += r.mismatch
+        per_tenant[t].lat.extend(r.lat)
+    lat = [x for r in per_tenant.values() for x in r.lat]
+    p50, p99 = _hist_pcts(lat, "elastic", qs=(0.50, 0.99))
+    ok = sum(r.ok for r in per_tenant.values())
+    sheds = sum(r.shed for r in per_tenant.values())
+    errors = sum(r.errors for r in per_tenant.values())
+    mismatches = sum(r.mismatch for r in per_tenant.values())
+    attempts = ok + sheds + errors
+    qps = {t: r.ok / max(high_s, 1e-9) for t, r in high_tenant.items()}
+    fairness = (max(qps.values()) / max(min(qps.values()), 1e-9)
+                if qps else 0.0)
+    return {
+        # `rows` = high-phase client count: the --check-regressions shape
+        # key, so a --smoke run never diffs against a full run
+        "rows": clients_high,
+        "clients_high": clients_high,
+        "clients_low": clients_low,
+        "duration_s": round(measured_s, 2),
+        "queries": ok,
+        "goodput_qps": round(ok / measured_s, 1),
+        "p50_ms": round(p50 * 1000, 1),
+        "p99_ms": round(p99 * 1000, 1),
+        "fairness_ratio": round(fairness, 3),
+        "shed_rate": round(sheds / max(attempts, 1), 4),
+        "client_errors": errors,
+        "bit_equal_frac": round((ok - mismatches) / max(ok, 1), 4),
+        "scale_ups": scale_ups,
+        "scale_downs": scale_downs,
+        "preemptions": int(preempted),
+        "preempt_kills": preempts_fired[0],
+        "retire_refused": retire_refused,
+        "agents_start": n_seed,
+        "agents_peak": max(agents_seen, default=n_seed),
+        "agents_final": agents_final,
+    }
+
+
+def main(argv=None):  # pragma: no cover — exercised via bench.py
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients-high", type=int, default=16)
+    ap.add_argument("--clients-low", type=int, default=3)
+    ap.add_argument("--rows", type=int, default=60_000)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_elastic_ramp(clients_high=args.clients_high,
+                                      clients_low=args.clients_low,
+                                      rows=args.rows),
+                     separators=(",", ":")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -49,6 +49,7 @@ QUANTUM = 1.0
 #: by the front's one mutex (checked by pixie_tpu.check.pxlint)
 _pxlint_locks_ = {
     "_retry_hint_locked": "self._lock",
+    "_effective_quota_locked": "self._lock",
     "_shed_locked": "self._lock",
     "_run_locked": "self._lock",
     "_eligible_locked": "self._lock",
@@ -85,21 +86,40 @@ class _TenantState:
     __slots__ = ("name", "bucket", "max_conc", "weight", "inflight",
                  "deficit", "queue")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, override: Optional[dict] = None):
         self.name = name
-        rate = spec_value(flags.get("PL_TENANT_QPS"), name, float)
+        self.inflight = 0
+        self.deficit = 0.0
+        self.queue: deque[Ticket] = deque()
+        self.configure(override)
+
+    def configure(self, override: Optional[dict] = None) -> None:
+        """(Re-)resolve this tenant's quotas: a LIVE override record (the
+        control-plane `set_quota` path, persisted in the broker KV) wins
+        field-by-field over the PL_TENANT_* env specs, which are demoted
+        to defaults.  Called in place on a quota update — inflight
+        accounting, DRR deficit and the queue are untouched, so the new
+        share applies from the very next scheduling round.  A changed QPS
+        mints a fresh token bucket (burst resets — an updated rate limit
+        starts from its own burst budget, not the old bucket's debt)."""
+        ov = override or {}
+        name = self.name
+        rate = ov.get("qps")
+        if rate is None:
+            rate = spec_value(flags.get("PL_TENANT_QPS"), name, float)
         self.bucket = TokenBucket(rate) if rate else None
-        conc = spec_value(flags.get("PL_TENANT_CONCURRENCY"), name, int)
+        conc = ov.get("concurrency")
+        if conc is None:
+            conc = spec_value(flags.get("PL_TENANT_CONCURRENCY"), name, int)
         self.max_conc = int(conc) if conc else 0  # 0 = unlimited
         # clamped: the dispatch loop's round budget is O(cost/min_weight)
         # UNDER THE FRONT'S LOCK, so a configured weight of 1e-6 must not
         # turn one dispatch into minutes of lock-held sweeping — 0.01 still
         # deprioritizes a tenant 100:1 against the default
-        w = spec_value(flags.get("PL_TENANT_WEIGHTS"), name, float) or 1.0
-        self.weight = min(max(w, 0.01), 100.0)
-        self.inflight = 0
-        self.deficit = 0.0
-        self.queue: deque[Ticket] = deque()
+        w = ov.get("weight")
+        if w is None:
+            w = spec_value(flags.get("PL_TENANT_WEIGHTS"), name, float) or 1.0
+        self.weight = min(max(float(w), 0.01), 100.0)
 
 
 class ServingFront:
@@ -109,6 +129,13 @@ class ServingFront:
         self.service = service
         self._lock = threading.Lock()
         self._tenants: dict[str, _TenantState] = {}
+        #: live per-tenant quota overrides (the control plane's `set_quota`
+        #: records, persisted by the broker in its KV): resolved ahead of
+        #: the PL_TENANT_* env specs field-by-field
+        self._quota_overrides: dict[str, dict] = {}
+        #: measured service-rate model (serving/ratemodel.py), set by the
+        #: broker; None keeps every retry hint on the PR 8 heuristic
+        self.rate_model = None
         self._rr: list[str] = []  # stable DRR visit order
         self._rr_idx = 0
         self.inflight = 0
@@ -163,9 +190,78 @@ class ServingFront:
                     self._tenants.pop(n, None)
                 self._rr = [n for n in self._rr if n in self._tenants]
                 self._rr_idx = 0
-            st = self._tenants[tenant] = _TenantState(tenant)
+            st = self._tenants[tenant] = _TenantState(
+                tenant, self._quota_overrides.get(tenant))
             self._rr.append(tenant)
         return st
+
+    #: live quota records arrive on the wire (set_quota frames), so their
+    #: count is bounded like every other wire-supplied id space — past the
+    #: cap new tenants are rejected with a clean error (clears always work)
+    MAX_QUOTA_RECORDS = 4096
+
+    # ------------------------------------------------------------ live quotas
+    def set_quota(self, tenant: str, record: Optional[dict]) -> dict:
+        """Apply one live quota record (already normalized by
+        admission.normalize_quota; None or an all-None record clears the
+        override back to the env-spec defaults).  An existing tenant state
+        reconfigures IN PLACE — queue, inflight accounting and DRR deficit
+        survive, so the new share takes effect within one scheduling
+        round — and the dispatch loop runs immediately (a raised
+        concurrency cap or weight may unblock queued work right now).
+        Returns the tenant's effective quotas after the update."""
+        if record is not None and all(v is None for v in record.values()):
+            record = None
+        with self._lock:
+            if record is None:
+                self._quota_overrides.pop(tenant, None)
+            else:
+                if (tenant not in self._quota_overrides
+                        and len(self._quota_overrides)
+                        >= self.MAX_QUOTA_RECORDS):
+                    from pixie_tpu.status import Unavailable
+
+                    raise Unavailable(
+                        f"live quota records capped at "
+                        f"{self.MAX_QUOTA_RECORDS}; clear unused tenants "
+                        "first")
+                self._quota_overrides[tenant] = dict(record)
+            st = self._tenants.get(tenant)
+            if st is not None:
+                st.configure(self._quota_overrides.get(tenant))
+                self._dispatch_locked()
+            eff = self._effective_quota_locked(tenant, st)
+        metrics.counter_inc(
+            "px_serving_quota_updates_total",
+            labels={"tenant": self._label(tenant)},
+            help_="live tenant quota records applied via the control plane")
+        return eff
+
+    def _effective_quota_locked(self, tenant: str,
+                                st: Optional[_TenantState]) -> dict:
+        ov = self._quota_overrides.get(tenant, {})
+        if st is not None:
+            rate = st.bucket.rate if st.bucket is not None else 0
+            conc, weight = st.max_conc, st.weight
+        else:
+            probe = _TenantState(tenant, ov or None)
+            rate = probe.bucket.rate if probe.bucket is not None else 0
+            conc, weight = probe.max_conc, probe.weight
+        return {"qps": rate, "concurrency": conc, "weight": weight,
+                "live": bool(ov)}
+
+    def quotas(self) -> dict[str, dict]:
+        """Effective quotas per tenant (every override plus every active
+        tenant state) — the `get_quotas` control-plane read."""
+        with self._lock:
+            names = sorted(set(self._quota_overrides) | set(self._tenants))
+            return {n: self._effective_quota_locked(n, self._tenants.get(n))
+                    for n in names}
+
+    def quota_overrides(self) -> dict[str, dict]:
+        """The raw live override records (what the broker persists)."""
+        with self._lock:
+            return {t: dict(r) for t, r in self._quota_overrides.items()}
 
     def enabled(self) -> bool:
         return enabled()
@@ -182,6 +278,7 @@ class ServingFront:
     def reset_for_testing(self) -> None:
         with self._lock:
             self._tenants.clear()
+            self._quota_overrides.clear()
             self._rr.clear()
             self._rr_idx = 0
             self.inflight = self.total_queued = 0
@@ -298,8 +395,13 @@ class ServingFront:
 
     # --------------------------------------------------------------- internals
     def _retry_hint_locked(self, cap: int) -> float:
-        # crude drain-time estimate: queued work over capacity, floored at
-        # 0.5s so clients don't hammer a saturated broker
+        # measured drain time when the rate model is warm (queued work over
+        # the measured completion rate, serving/ratemodel.py); the crude
+        # queued-over-capacity estimate floored at 0.5s only while cold
+        if self.rate_model is not None:
+            ra = self.rate_model.retry_after_s(self.total_queued, cap)
+            if ra is not None:
+                return ra
         return min(30.0, 0.5 + self.total_queued / max(1, cap))
 
     def _shed_locked(self, t: Ticket, reason: str, retry_after: float,

@@ -152,6 +152,10 @@ al = al.groupby(['slo', 'tenant', 'window', 'state']).agg(
     max_burn=('burn_rate', px.max),
 )
 px.display(al, '3 slo alert edges')
+sc = px.DataFrame(table='self_telemetry.scale_events')
+sc = sc[['time_', 'action', 'agent', 'reason', 'pressure', 'agents']]
+sc = sc.head(30)
+px.display(sc, '4 autoscaler decisions')
 """
 
 _PROFILES_PAGE = """<!doctype html>

@@ -530,3 +530,77 @@ def test_observe_overhead_harness_crash_fails_guard():
         "configs.observe_overhead.overhead_frac"]
     assert regs[0].get("missing")
     assert "missing at guarded shape" in bench._format_regression(regs[0])
+
+
+# ----------------------------------------------------------- elastic_ramp
+
+
+def _elastic_doc(rows=16, fairness=1.1, errors=0, bit_equal=1.0,
+                 scale_ups=3, scale_downs=2, preemptions=1, p99=900.0,
+                 goodput=80.0):
+    doc = _doc()
+    doc["configs"]["elastic_ramp"] = {
+        "rows": rows, "duration_s": 16.0, "queries": 1200,
+        "goodput_qps": goodput, "p50_ms": 20.0, "p99_ms": p99,
+        "fairness_ratio": fairness, "shed_rate": 0.0,
+        "client_errors": errors, "bit_equal_frac": bit_equal,
+        "scale_ups": scale_ups, "scale_downs": scale_downs,
+        "preemptions": preemptions, "agents_start": 2, "agents_peak": 5,
+        "agents_final": 2,
+    }
+    return doc
+
+
+def test_elastic_ramp_points_guarded():
+    """elastic_ramp is a guarded goodput AND latency config (shape-matched
+    on the high-phase client count)."""
+    pts = bench.bench_points(_elastic_doc())
+    assert pts["configs.elastic_ramp.goodput_qps"] == (80.0, 16)
+    lpts = bench.bench_latency_points(_elastic_doc())
+    assert lpts["configs.elastic_ramp.p99_ms"] == (900.0, 16)
+    assert lpts["configs.elastic_ramp.p50_ms"] == (20.0, 16)
+    regs = bench.compare_bench(_elastic_doc(),
+                               _elastic_doc(goodput=40.0, p99=2000.0),
+                               threshold=0.15)
+    keys = [r["key"] for r in regs]
+    assert "configs.elastic_ramp.goodput_qps" in keys
+    assert "configs.elastic_ramp.p99_ms" in keys
+
+
+def test_elastic_ramp_absolute_guards():
+    """The ROADMAP-4 acceptance holds ABSOLUTELY: scale both ways with a
+    real preemption, fairness <= 2.0, zero client errors, bit-equal
+    results, bounded interactive p99."""
+    assert bench.absolute_floors(_elastic_doc()) == []
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(scale_ups=0))] == ["configs.elastic_ramp.scale_ups"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(scale_downs=0))] == [
+            "configs.elastic_ramp.scale_downs"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(preemptions=0))] == [
+            "configs.elastic_ramp.preemptions"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(bit_equal=0.999))] == [
+            "configs.elastic_ramp.bit_equal_frac"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(fairness=2.4))] == [
+            "configs.elastic_ramp.fairness_ratio"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(errors=1))] == ["configs.elastic_ramp.client_errors"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _elastic_doc(p99=25_000.0))] == ["configs.elastic_ramp.p99_ms"]
+    # smoke/quick shapes never trip the full-shape bounds
+    assert bench.absolute_floors(
+        _elastic_doc(rows=10, scale_ups=0, fairness=9.0, errors=3)) == []
+
+
+def test_elastic_ramp_harness_crash_fails_guards():
+    """A crashed elastic harness at the guarded shape must TRIP the
+    absolute guards (missing-key rule), never silently disable them."""
+    doc = _doc()
+    doc["configs"]["elastic_ramp"] = {"rows": 16, "error": "boom"}
+    regs = bench.absolute_floors(doc)
+    assert len(regs) >= 7
+    assert all(r["key"].startswith("configs.elastic_ramp") for r in regs)
+    assert all(r.get("missing") for r in regs)

@@ -192,11 +192,13 @@ def cluster():
 def test_broker_distributed_query_matches_local(cluster):
     broker, stores, agents, client = cluster
     # every agent also carries the self-telemetry tables (spans + the
-    # query flight recorder's profiles/op-stats/metrics/alerts)
+    # query flight recorder's profiles/op-stats/metrics/alerts + the
+    # autoscaler's scale-event journal)
     assert set(client.schemas()) == {
         "http_events", "self_telemetry.spans",
         "self_telemetry.query_profiles", "self_telemetry.op_stats",
-        "self_telemetry.metrics", "self_telemetry.alerts"}
+        "self_telemetry.metrics", "self_telemetry.alerts",
+        "self_telemetry.scale_events"}
     res = client.execute_script(SCRIPT)["out"]
     # oracle: LocalCluster over the same stores
     from pixie_tpu.parallel.cluster import LocalCluster
