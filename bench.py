@@ -649,13 +649,15 @@ def bench_observe_overhead(rows=200_000, repeats=48):
     measured — warm distributed dashboard queries (2-agent LocalCluster,
     plan-cache + matview warm: the per-query cost is pure instrumentation,
     not compile noise) timed with the recorder ON (tracing + per-query
-    profiles + SLO recording, PL_TRACING_ENABLED=1 + PL_SLO set) vs fully
-    OFF (PL_TRACING_ENABLED=0).  Arms run in alternating interleaved
-    blocks and compare medians, so background load hits both equally.
-    `overhead_frac` is guarded ABSOLUTELY at <= 5% (bench ABS_CEILINGS)."""
+    profiles + SLO recording + shard-heat accounting on every executor
+    feed, PL_TRACING_ENABLED=1 + PL_SLO set) vs fully OFF
+    (PL_TRACING_ENABLED=0).  Arms run in alternating interleaved blocks
+    and compare medians, so background load hits both equally.
+    `overhead_frac` is guarded ABSOLUTELY at <= 5% (bench ABS_CEILINGS);
+    `heat_cells` proves the on-arm really paid the heat-model tax."""
     from pixie_tpu import flags
     from pixie_tpu.parallel.cluster import LocalCluster
-    from pixie_tpu.table import TableStore
+    from pixie_tpu.table import TableStore, heat
 
     import pixie_tpu.serving.slo  # noqa: F401 — defines PL_SLO
     import pixie_tpu.trace  # noqa: F401 — defines PL_TRACING_ENABLED
@@ -666,6 +668,7 @@ def bench_observe_overhead(rows=200_000, repeats=48):
     try:
         flags.set_for_testing(
             "PL_SLO", "interactive:latency<500ms@99;availability:errors@99")
+        heat.reset_for_testing()
         for arm in (False, True):
             flags.set_for_testing("PL_TRACING_ENABLED", arm)
             stores = {}
@@ -701,6 +704,9 @@ def bench_observe_overhead(rows=200_000, repeats=48):
         "overhead_frac": round(max(0.0, on_p50 / max(off_p50, 1e-9) - 1.0),
                                4),
         "samples_per_arm": len(times[True]),
+        # shard-heat model cells populated by the ON arm only (the OFF arm
+        # must never touch it) — 0 here means the tax wasn't measured
+        "heat_cells": len(heat.MODEL._cells),
     }
 
 

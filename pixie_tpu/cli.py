@@ -6,6 +6,7 @@ services).
     python -m pixie_tpu.cli scripts --bundle DIR
     python -m pixie_tpu.cli broker [--port P] [--datastore PATH]
     python -m pixie_tpu.cli agent --name N --broker H:P [--connector seq_gen]
+    python -m pixie_tpu.cli storage --broker H:P   # df for the data plane
 
 Results render as aligned text tables with semantic-aware formatting
 (durations, bytes, percentages) — the CLI analog of the Live UI's table view.
@@ -424,6 +425,60 @@ def cmd_quota(args) -> int:
     return 0
 
 
+def cmd_storage(args) -> int:
+    """`df` for the data plane: the broker's cluster heat map (heat_map
+    RPC) rendered as per-table shard heat + skew and per-agent storage
+    state (hot rows, sealed batches, journal/resident/matview bytes,
+    replication lag)."""
+    from pixie_tpu.services.client import Client, QueryError
+
+    host, port = args.broker.rsplit(":", 1)
+    client = Client(host, int(port), auth_token=args.auth_token)
+    try:
+        hm = client.heat_map()
+    except QueryError as e:
+        raise SystemExit(f"storage: {e}") from None
+    finally:
+        client.close()
+    tables = hm.get("tables") or {}
+    if tables:
+        print("-- shard heat (decayed rows scanned):")
+        print(f"   {'table':<34} {'shard':<12} {'heat':>12} "
+              f"{'scanned':>10} {'bytes':>10}  skew")
+        for tname in sorted(tables):
+            t = tables[tname]
+            shards = t.get("shards") or {}
+            for i, sh in enumerate(sorted(shards)):
+                skew = f"{t.get('skew', 1.0):.3f}" if i == 0 else ""
+                print(f"   {tname[:34]:<34} {sh[:12]:<12} "
+                      f"{shards[sh]:>12.1f} {t.get('rows_scanned', 0):>10} "
+                      f"{_fmt_bytes(t.get('bytes', 0)):>10}  {skew}")
+    else:
+        print("no shard heat recorded (is PL_TRACING_ENABLED on and has "
+              "anything queried?)")
+    agents = hm.get("agents") or {}
+    for name in sorted(agents):
+        rep = agents[name]
+        if rep.get("error"):
+            print(f"-- agent {name}: error: {rep['error']}")
+            continue
+        print(f"-- agent {name} storage state:")
+        print(f"   {'table':<34} {'hot':>8} {'sealed':>7} {'bytes':>10} "
+              f"{'journal':>10} {'resident':>10} {'matview':>10} "
+              f"{'lag':>4}  ages")
+        for r in rep.get("storage_state") or []:
+            print(f"   {str(r.get('table_name', ''))[:34]:<34} "
+                  f"{r.get('hot_rows', 0):>8} "
+                  f"{r.get('sealed_batches', 0):>7} "
+                  f"{_fmt_bytes(r.get('sealed_bytes', 0)):>10} "
+                  f"{_fmt_bytes(r.get('journal_bytes', 0)):>10} "
+                  f"{_fmt_bytes(r.get('resident_bytes', 0)):>10} "
+                  f"{_fmt_bytes(r.get('matview_bytes', 0)):>10} "
+                  f"{r.get('repl_lag_batches', 0):>4}  "
+                  f"{r.get('age_histogram', '') or '-'}")
+    return 0
+
+
 def cmd_agent(args) -> int:
     from pixie_tpu.services.agent import main as agent_main
 
@@ -519,6 +574,12 @@ def main(argv=None) -> int:
     qw.add_argument("--broker", required=True, help="host:port")
     qw.add_argument("--auth-token", default=None)
     qw.set_defaults(fn=cmd_quota)
+
+    st = sub.add_parser("storage",
+                        help="cluster heat map: df for the data plane")
+    st.add_argument("--broker", required=True, help="host:port")
+    st.add_argument("--auth-token", default=None)
+    st.set_defaults(fn=cmd_storage)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)

@@ -1289,6 +1289,22 @@ class PlanExecutor:
         hb = self._eval_blocking(head)
         return hb.dtypes, hb.dicts, hb, list(hb.cols), list(hb.cols), None, MIN_BUCKET
 
+    def _heat_recorder(self, src):
+        """Shard-heat accounting hook shared by every scan path (the
+        coalescing `_feed`, np_partial's fused window loop, the wholeplan
+        native loop): a per-stream FeedRecorder, or None when tracing is
+        off or `src` is not a storage cursor — flag-off never touches the
+        model."""
+        from pixie_tpu import observe as _observe
+
+        table = getattr(src, "table", None)
+        if table is None or not _observe.enabled():
+            return None
+        from pixie_tpu.table import heat as _heat
+
+        return _heat.FeedRecorder(
+            table, getattr(self.store, "node_name", "") or "local")
+
     def _note_shard_rows(self, per_shard) -> None:
         """Per-shard placement accounting for SPMD feeds: accumulates each
         feed's per-shard valid rows and keeps the skew ratio (max/mean shard
@@ -1363,6 +1379,10 @@ class PlanExecutor:
         target = max(cap, FEED_ROWS)
         table_id = src.table.uid
         n_dev = self.mesh.size if (spmd and self.mesh is not None) else 1
+        # Shard-heat accounting (table/heat.py): one recorder per feed
+        # stream, bumped per coalesced emit with the serving tier.  Gated on
+        # the tracing master switch — flag-off never touches the model.
+        heat_rec = self._heat_recorder(src)
 
         def emit(parts, gens, n):
             # Sealed-only feeds are immutable → serve/place them from the HBM
@@ -1402,6 +1422,8 @@ class PlanExecutor:
                         self.stats.get("resident_feeds", 0) + 1)
                     self.stats["h2d_bytes"] = (
                         self.stats.get("h2d_bytes", 0) + h2d)
+                    if heat_rec is not None:
+                        heat_rec.record(parts, gens, "resident")
                     return rcols, n
             dkey = ((table_id, tuple(gens), tuple(names), n_dev, backend)
                     if cacheable else None)
@@ -1409,6 +1431,8 @@ class PlanExecutor:
                 cached = _device_cache_get(dkey)
                 if cached is not None:
                     self.stats["feed_cache_hits"] = self.stats.get("feed_cache_hits", 0) + 1
+                    if heat_rec is not None:
+                        heat_rec.record(parts, gens, "hbm_cache")
                     return dict(cached), n
             # Single-copy assembly: write every storage batch straight into the
             # padded bucket buffer (concatenate-then-pad would copy twice).
@@ -1438,6 +1462,8 @@ class PlanExecutor:
                 self.stats["h2d_bytes"] = (
                     self.stats.get("h2d_bytes", 0)
                     + sum(v.nbytes for v in cols.values()))
+            if heat_rec is not None:
+                heat_rec.record(parts, gens, "stream")
             return cols, n
 
         pend, gens, nrows = [], [], 0

@@ -470,6 +470,18 @@ def tier_stats() -> dict:
         return {"entries": len(_TIER), "bytes": _TIER_BYTES, **stats}
 
 
+def per_table_bytes() -> dict[int, int]:
+    """{table_uid: pinned HBM bytes} — the storage-state fold's view of who
+    holds the resident budget (entries are keyed (table_uid, names,
+    n_dev))."""
+    out: dict[int, int] = {}
+    with _LOCK:
+        for key, e in _TIER.items():
+            uid = int(key[0])
+            out[uid] = out.get(uid, 0) + int(e.nbytes)
+    return out
+
+
 def clear_for_testing() -> None:
     global _TIER_BYTES
     with _LOCK:

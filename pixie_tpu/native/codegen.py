@@ -534,12 +534,15 @@ def run(executor, prog: Program, src, num_groups, init_specs, t_lo, t_hi,
     deterministic regardless of scheduling."""
     lib = _native()
     bound = _Bound(prog, luts, t_lo, t_hi, num_groups)
+    heat_rec = executor._heat_recorder(src)
     batches = []
     total = 0
     for rb, _row_id, _gen in src:
         n = rb.num_valid
         if n == 0:
             continue
+        if heat_rec is not None:
+            heat_rec.record_batch(rb, n, _gen)
         cols = []
         for cname in prog.cols:
             a = rb.columns[cname][:n]

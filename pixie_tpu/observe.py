@@ -62,6 +62,8 @@ OP_STATS_TABLE = "self_telemetry.op_stats"
 METRICS_TABLE = "self_telemetry.metrics"
 ALERTS_TABLE = "self_telemetry.alerts"
 SCALE_EVENTS_TABLE = "self_telemetry.scale_events"
+SHARD_HEAT_TABLE = "self_telemetry.shard_heat"
+STORAGE_STATE_TABLE = "self_telemetry.storage_state"
 
 PROFILES_RELATION = Relation.of(
     ("time_", DT.TIME64NS, ST.ST_TIME_NS),
@@ -143,12 +145,54 @@ SCALE_EVENTS_RELATION = Relation.of(
     ("agents", DT.INT64),
 )
 
+#: the storage-side twin of the query profiles (pixie_tpu.table.heat): the
+#: decayed per-(table, shard, serving tier, batch-age bucket) access model,
+#: folded on the PL_SELF_METRICS_S cron.  `skew` is the per-table max/mean
+#: shard heat — the signal the shard rebalancer (ROADMAP item 2) reads.
+SHARD_HEAT_RELATION = Relation.of(
+    ("time_", DT.TIME64NS, ST.ST_TIME_NS),
+    ("table_name", DT.STRING),
+    ("shard", DT.STRING),
+    ("tier", DT.STRING),
+    ("age_bucket", DT.STRING),
+    ("rows_scanned", DT.INT64),
+    ("bytes", DT.INT64, ST.ST_BYTES),
+    ("heat", DT.FLOAT64),
+    ("skew", DT.FLOAT64),
+    ("last_access", DT.TIME64NS, ST.ST_TIME_NS),
+)
+
+#: per-(agent, table) storage accounting: what each agent actually HOLDS —
+#: hot rows, sealed batches with their age histogram (JSON {bucket: count}),
+#: journal bytes/segments on disk, resident-tier and matview state bytes,
+#: and replication lag as the sealed-vs-acked watermark delta per peer
+#: (`peer_lag` is JSON {peer: batches}; `repl_lag_batches` its max).  The
+#: journal/replication columns are per-table (journals are per-table files;
+#: lag is stamped on every row of the owning agent for joinability).
+STORAGE_STATE_RELATION = Relation.of(
+    ("time_", DT.TIME64NS, ST.ST_TIME_NS),
+    ("agent", DT.STRING),
+    ("table_name", DT.STRING),
+    ("hot_rows", DT.INT64),
+    ("sealed_batches", DT.INT64),
+    ("sealed_bytes", DT.INT64, ST.ST_BYTES),
+    ("age_histogram", DT.STRING),
+    ("resident_bytes", DT.INT64, ST.ST_BYTES),
+    ("matview_bytes", DT.INT64, ST.ST_BYTES),
+    ("journal_bytes", DT.INT64, ST.ST_BYTES),
+    ("journal_segments", DT.INT64),
+    ("repl_lag_batches", DT.INT64),
+    ("peer_lag", DT.STRING),
+)
+
 SELF_TABLES: dict[str, Relation] = {
     PROFILES_TABLE: PROFILES_RELATION,
     OP_STATS_TABLE: OP_STATS_RELATION,
     METRICS_TABLE: METRICS_RELATION,
     ALERTS_TABLE: ALERTS_RELATION,
     SCALE_EVENTS_TABLE: SCALE_EVENTS_RELATION,
+    SHARD_HEAT_TABLE: SHARD_HEAT_RELATION,
+    STORAGE_STATE_TABLE: STORAGE_STATE_RELATION,
 }
 
 

@@ -174,6 +174,10 @@ class LocalCluster:
     def __init__(self, stores: dict, merger_store: Optional[TableStore] = None,
                  registry=None, n_devices_per_agent: Optional[int] = None):
         self.stores = dict(stores)
+        for name, store in self.stores.items():
+            # shard identity for the heat model (table/heat.py): feeds over
+            # each agent store account as that agent's shard
+            store.node_name = name
         if self.stores:
             from pixie_tpu import observe as _observe
             from pixie_tpu import trace as _trace
@@ -394,6 +398,28 @@ class LocalCluster:
         batches)."""
         store = self.stores[sorted(self.stores)[0]]
         return self._telemetry.flush_into(store, force=True)
+
+    def fold_storage_observatory(self) -> int:
+        """The broker-less analog of the agents' PL_SELF_METRICS_S cron:
+        fold the decayed shard-heat snapshot plus EVERY agent store's
+        storage state into the telemetry store (table/heat.py), so
+        self_telemetry.shard_heat / .storage_state answer on a LocalCluster
+        deployment too.  Returns rows written (0 with tracing off)."""
+        from pixie_tpu import observe as _observe
+        from pixie_tpu.table import heat as _heat
+
+        if not _observe.enabled() or not self.stores:
+            return 0
+        telemetry_store = self.stores[sorted(self.stores)[0]]
+        n = _observe.write_rows(telemetry_store, _observe.SHARD_HEAT_TABLE,
+                                _heat.snapshot_rows())
+        for name in sorted(self.stores):
+            n += _observe.write_rows(
+                telemetry_store, _observe.STORAGE_STATE_TABLE,
+                _heat.storage_state_rows(
+                    self.stores[name], name,
+                    matviews=self._mv_managers.get(name)))
+        return n
 
     def _query(self, pxl_source, func, func_args, now, default_limit,
                analyze, tenant, prof=None, explain: bool = False):

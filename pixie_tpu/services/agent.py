@@ -23,6 +23,7 @@ from pixie_tpu.plan.plan import Plan
 from pixie_tpu.services import replication as _replication
 from pixie_tpu.services import wire
 from pixie_tpu.services.transport import Connection, dial
+from pixie_tpu.table import heat as _heat
 from pixie_tpu.table import journal as _journal
 from pixie_tpu.table.table import TableStore
 
@@ -80,10 +81,14 @@ class Agent:
                 "broker_conn": lambda: (self.conn is not None
                                         and not self.conn.closed),
                 "registered": lambda: self._registered.is_set(),
-            }, host=healthz_host, port=healthz_port)
+            }, host=healthz_host, port=healthz_port,
+                detail={"journal": self._journal_detail})
         self.name = name
         self.broker = (broker_host, broker_port)
         self.store = store or (collector.store if collector else TableStore())
+        #: shard identity for the heat model (table/heat.py): executor feeds
+        #: over this store account as this agent's shard
+        self.store.node_name = name
         self.collector = collector
         self.registry = registry
         self.heartbeat_s = heartbeat_s
@@ -161,10 +166,35 @@ class Agent:
             # plane), stamped with the agent's own service name
             self._self_metrics = Ticker(
                 f"self_metrics_{self.name}", period,
-                lambda: observe.write_rows(
-                    self.store, observe.METRICS_TABLE,
-                    observe.sample_metrics_rows(self.name))).start()
+                self._fold_self_metrics).start()
         return self
+
+    def _journal_detail(self) -> dict:
+        """Per-table journal disk usage for the /healthz detail payload:
+        PL_JOURNAL_MAX_MB pruning pressure, visible before it bites."""
+        from pixie_tpu.table.table import Table
+
+        tables = {}
+        total = 0
+        for name in self.store.names():
+            t = self.store._tables.get(name)
+            j = getattr(t, "journal", None) if isinstance(t, Table) else None
+            if j is None:
+                continue
+            nbytes, nsegs = j.disk_usage()
+            tables[name] = {"bytes": nbytes, "segments": nsegs}
+            total += nbytes
+        return {"tables": tables, "total_bytes": total,
+                "budget_mb": int(flags.get("PL_JOURNAL_MAX_MB"))}
+
+    def _fold_self_metrics(self) -> None:
+        """PL_SELF_METRICS_S cron body: the metrics registry plus the
+        storage observatory (decayed shard heat + per-table storage state,
+        table/heat.py) fold into the local store."""
+        observe.write_rows(self.store, observe.METRICS_TABLE,
+                           observe.sample_metrics_rows(self.name))
+        _heat.fold_into(self.store, self.name, matviews=self.matviews,
+                        replication=self.replication)
 
     def stop(self):
         self._stop.set()
@@ -344,6 +374,15 @@ class Agent:
                 daemon=True,
                 name=f"pixie-agent-telemetry-{self.name}",
             ).start()
+        elif msg == "storage_report":
+            # on-demand storage observatory read (broker heat_map RPC):
+            # current decayed heat + storage state, NOT a fold — nothing is
+            # written.  Off the read loop: the state walk takes table locks.
+            threading.Thread(
+                target=self._answer_storage_report,
+                args=(payload.get("req_id"),), daemon=True,
+                name=f"pixie-agent-storage-{self.name}",
+            ).start()
         elif msg == "deploy_tracepoint":
             try:
                 self.tracepoints.apply([payload["spec"]])
@@ -379,9 +418,31 @@ class Agent:
                 break
         synced = (self.replication is not None
                   and self.replication.wait_synced(0.5))
+        # per-peer watermark detail: the drain audit used to infer "synced"
+        # as a bare bool — now the sent/acked/lag numbers behind the verdict
+        # travel with it
+        peer_sync = (self.replication.sync_state()
+                     if self.replication is not None else {})
         self.conn.send(wire.encode_json({
             "msg": "retire_info", "req_id": req_id,
-            "agent": self.name, "rows": rows, "repl_synced": synced}))
+            "agent": self.name, "rows": rows, "repl_synced": synced,
+            "peer_sync": peer_sync}))
+
+    def _answer_storage_report(self, req_id) -> None:
+        """One storage_report RPC answer: this agent's decayed shard-heat
+        snapshot + storage-state rows (table/heat.py), as JSON."""
+        try:
+            report = {
+                "shard_heat": _heat.snapshot_rows(),
+                "storage_state": _heat.storage_state_rows(
+                    self.store, self.name, matviews=self.matviews,
+                    replication=self.replication),
+            }
+        except Exception as e:
+            report = {"error": str(e)}
+        self.conn.send(wire.encode_json({
+            "msg": "storage_report", "req_id": req_id,
+            "agent": self.name, **report}))
 
     def _execute(self, meta: dict):
         import contextlib

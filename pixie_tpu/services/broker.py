@@ -740,13 +740,21 @@ class Broker:
                     "quotas": self.serving.quotas(),
                     "rate_model": self.ratemodel.snapshot(),
                 }))
-            elif msg == "retire_info":
-                # reply to a broker→agent retire drain audit (retire_agent)
+            elif msg in ("retire_info", "storage_report"):
+                # reply to a broker→agent control RPC (retire drain audit /
+                # heat_map storage fan-out)
                 with self._qlock:
                     slot = self._control_replies.get(payload.get("req_id"))
                 if slot is not None:
                     slot[1] = payload
                     slot[0].set()
+            elif msg == "heat_map":
+                # cluster storage observatory read ("df for the data
+                # plane") — off the read loop: it blocks on per-agent RPCs
+                threading.Thread(
+                    target=self._answer_heat_map, args=(conn, payload),
+                    daemon=True, name="pixie-broker-heatmap",
+                ).start()
             elif msg == "deregister_agent":
                 # operator decommission: drop the durable record so the
                 # shard map stops treating the retired node as a failover
@@ -1023,6 +1031,57 @@ class Broker:
             with self._qlock:
                 self._control_replies.pop(rid, None)
 
+    def _answer_heat_map(self, conn: Connection, payload: dict) -> None:
+        """Aggregate every live agent's storage_report into the cluster
+        heat map: per-agent raw reports plus a per-table rollup (shard →
+        summed decayed heat, cluster skew = max/mean shard heat).  Consumed
+        by `pixie_tpu.cli storage`; also refreshes the px_journal_bytes
+        gauge family from the reports (the broker may be the only scraped
+        process in a multi-process deployment)."""
+        from pixie_tpu import metrics as _metrics
+
+        agents: dict = {}
+        for rec in self.registry.live_agents():
+            try:
+                rep = self._agent_rpc(rec.name, {"msg": "storage_report"},
+                                      timeout=5.0)
+            except TimeoutError as e:
+                agents[rec.name] = {"error": str(e)}
+                continue
+            agents[rec.name] = {
+                "shard_heat": rep.get("shard_heat") or [],
+                "storage_state": rep.get("storage_state") or [],
+                **({"error": rep["error"]} if rep.get("error") else {}),
+            }
+        tables: dict = {}
+        for rep in agents.values():
+            for r in rep.get("shard_heat") or []:
+                t = tables.setdefault(str(r.get("table_name")), {
+                    "shards": {}, "rows_scanned": 0, "bytes": 0})
+                sh = str(r.get("shard"))
+                t["shards"][sh] = (t["shards"].get(sh, 0.0)
+                                   + float(r.get("heat") or 0.0))
+                t["rows_scanned"] += int(r.get("rows_scanned") or 0)
+                t["bytes"] += int(r.get("bytes") or 0)
+        for t in tables.values():
+            heats = list(t["shards"].values())
+            mean = sum(heats) / max(len(heats), 1)
+            t["skew"] = round(max(heats) / mean, 4) if mean > 0 else 1.0
+        jbytes: dict = {}
+        for name, rep in agents.items():
+            for r in rep.get("storage_state") or []:
+                jbytes[name] = (jbytes.get(name, 0)
+                                + int(r.get("journal_bytes") or 0))
+        for name, b in jbytes.items():
+            _metrics.gauge_set(
+                "px_journal_bytes", float(b),
+                labels={"agent": _metrics.capped_label("heat_shard", name)},
+                help_="journal bytes on disk per agent (PL_JOURNAL_MAX_MB "
+                      "pruning pressure)")
+        conn.send(wire.encode_json({
+            "msg": "heat_map", "req_id": payload.get("req_id"),
+            "agents": agents, "tables": tables}))
+
     def retire_agent(self, name: str, force: bool = False) -> dict:
         """Scale-down decommission with loss safety (the autoscaler's
         retire path; serving/elastic.py).  Protocol:
@@ -1042,13 +1101,16 @@ class Broker:
              replicated sealed batches.
              rows > 0 otherwise → REFUSED (retiring it would lose rows).
 
-        Returns {ok, mode: deregister|handoff|None, rows, reason}."""
+        Returns {ok, mode: deregister|handoff|None, rows, reason,
+        peer_sync} — peer_sync is the agent's per-peer replication
+        watermark detail ({peer: {sent, acked, lag}}), so the audit's
+        "synced" verdict ships with the numbers behind it."""
         from pixie_tpu import metrics as _metrics
 
         rec = self.registry.record(name)
         if rec is None:
             return {"ok": False, "mode": None, "rows": None,
-                    "reason": "unknown agent"}
+                    "reason": "unknown agent", "peer_sync": {}}
         sole = self._sole_holder_of(name)
         if sole and not force:
             _metrics.counter_inc(
@@ -1057,14 +1119,17 @@ class Broker:
                       "(last live shard holder, unauditable rows, or "
                       "unsynced replication)")
             return {"ok": False, "mode": None, "rows": None,
-                    "reason": f"last live holder of shard(s) {sole}"}
+                    "reason": f"last live holder of shard(s) {sole}",
+                    "peer_sync": {}}
         rows = None
         repl_synced = False
+        peer_sync: dict = {}
         try:
             reply = self._agent_rpc(name, {"msg": "retire_query"},
                                     timeout=5.0)
             rows = int(reply.get("rows", -1))
             repl_synced = bool(reply.get("repl_synced"))
+            peer_sync = dict(reply.get("peer_sync") or {})
         except TimeoutError:
             pass
         if rows is None or rows < 0:
@@ -1075,7 +1140,8 @@ class Broker:
                           "audit (last live shard holder, unauditable "
                           "rows, or unsynced replication)")
                 return {"ok": False, "mode": None, "rows": rows,
-                        "reason": "drain audit unanswered"}
+                        "reason": "drain audit unanswered",
+                        "peer_sync": peer_sync}
             rows = -1
         if rows > 0 and not force:
             reps = self.registry.shard_map().get(name) or []
@@ -1088,16 +1154,17 @@ class Broker:
                           "audit (last live shard holder, unauditable "
                           "rows, or unsynced replication)")
                 return {"ok": False, "mode": None, "rows": rows,
-                        "reason": "holds rows with no synced live replica"}
+                        "reason": "holds rows with no synced live replica",
+                        "peer_sync": peer_sync}
             # PR 12 hand-off: keep the durable record — the shard keeps
             # serving through failover from the replicated sealed batches
             # once the agent stops (the supervisor owns the stop)
             return {"ok": True, "mode": "handoff", "rows": rows,
-                    "reason": ""}
+                    "reason": "", "peer_sync": peer_sync}
         self.registry.deregister(name)
         self._push_shard_map()
         return {"ok": True, "mode": "deregister", "rows": rows,
-                "reason": ""}
+                "reason": "", "peer_sync": peer_sync}
 
     def reap_dead_agent(self, name: str) -> bool:
         """Deregister a DEAD supervisor-owned agent (preemption cleanup) —

@@ -132,7 +132,7 @@ class Client:
         elif msg == "schemas":
             p.schemas = meta["schemas"]
             p.done.set()
-        elif msg in ("quota_ok", "quotas"):
+        elif msg in ("quota_ok", "quotas", "heat_map"):
             p.reply = meta
             p.done.set()
         elif msg == "error":
@@ -287,6 +287,15 @@ class Client:
         reply = self._control_rpc({"msg": "get_quotas"})
         return {"tenants": reply.get("quotas") or {},
                 "rate_model": reply.get("rate_model") or {}}
+
+    def heat_map(self) -> dict:
+        """The cluster storage observatory ("df for the data plane"):
+        {agents: {name: {shard_heat, storage_state}}, tables: {name:
+        {shards, skew, rows_scanned, bytes}}} aggregated by the broker from
+        live agents' storage_report RPCs."""
+        reply = self._control_rpc({"msg": "heat_map"})
+        return {"agents": reply.get("agents") or {},
+                "tables": reply.get("tables") or {}}
 
     def _control_rpc(self, meta: dict) -> dict:
         rid, p = self._new_pending()

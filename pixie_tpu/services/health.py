@@ -30,11 +30,16 @@ class HealthzServer:
 
     def __init__(self, checks: Optional[dict[str, Callable]] = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 ready_checks: Optional[dict[str, Callable]] = None):
+                 ready_checks: Optional[dict[str, Callable]] = None,
+                 detail: Optional[dict[str, Callable]] = None):
         self.checks: dict[str, Callable] = dict(checks or {})
         #: extra checks for /readyz only (e.g. leadership): failing them
         #: means "alive but not serving", which must NOT fail liveness
         self.ready_checks: dict[str, Callable] = dict(ready_checks or {})
+        #: informational payloads (name -> callable returning a JSON-able
+        #: value) merged into the /healthz body under "detail" — never
+        #: affect the verdict (journal disk usage, queue depths, ...)
+        self.detail: dict[str, Callable] = dict(detail or {})
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -52,7 +57,11 @@ class HealthzServer:
                 if self.path in ("/healthz", "/readyz"):
                     ok, results = outer.run_checks(
                         ready=self.path == "/readyz")
-                    body = json.dumps({"ok": ok, "checks": results}).encode()
+                    doc = {"ok": ok, "checks": results}
+                    det = outer.run_detail()
+                    if det:
+                        doc["detail"] = det
+                    body = json.dumps(doc).encode()
                     return self._send(200 if ok else 503, body,
                                       "application/json")
                 if self.path == "/metrics":
@@ -65,6 +74,17 @@ class HealthzServer:
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
+
+    def run_detail(self) -> dict:
+        """Evaluate the informational payloads; a failing provider reports
+        its error in place rather than failing the probe."""
+        out = {}
+        for name, fn in self.detail.items():
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = f"error: {e}"
+        return out
 
     def add_ready_check(self, name: str, fn: Callable) -> None:
         """Register a READINESS-ONLY check: failing it flips /readyz while

@@ -55,6 +55,29 @@ def enabled() -> bool:
     return int(flags.get("PL_REPLICATION")) > 1
 
 
+#: live managers in this process (tests run several), for the per-peer lag
+#: gauge — registered once, reads every manager's sent/acked watermarks
+_MANAGERS: list = []
+_MANAGERS_LOCK = threading.Lock()
+
+
+def _lag_gauges() -> dict:
+    with _MANAGERS_LOCK:
+        mgrs = list(_MANAGERS)
+    out: dict = {}
+    for m in mgrs:
+        for peer, lag in m.lag().items():
+            key = (("peer", metrics.capped_label("repl_peer", peer)),)
+            out[key] = max(out.get(key, 0.0), float(lag))
+    return out
+
+
+metrics.register_gauge_fn(
+    "px_repl_lag_batches", _lag_gauges,
+    help_="sealed-vs-acked replication watermark delta per peer (batches "
+          "enqueued to the peer that it has not acked yet)")
+
+
 def encode_sealed(table, batch, row_id_start: int, primary: str,
                   seq: int) -> bytes:
     """One sealed RowBatch → a repl_batch frame.  Dictionary codes decode
@@ -292,6 +315,8 @@ class ReplicationManager:
         self._server.start()
         self._sender.start()
         self._attach(self.store)
+        with _MANAGERS_LOCK:
+            _MANAGERS.append(self)
         return self
 
     @property
@@ -302,6 +327,9 @@ class ReplicationManager:
         return ("127.0.0.1", self.port)
 
     def stop(self) -> None:
+        with _MANAGERS_LOCK:
+            if self in _MANAGERS:
+                _MANAGERS.remove(self)
         self._stop.set()
         self._q.put(None)
         self._server.stop()
@@ -416,6 +444,10 @@ class ReplicationManager:
             if stale is not None:
                 stale.close()
             if tries < self.SEND_RETRIES and not self._stop.is_set():
+                metrics.counter_inc(
+                    "px_repl_send_retries_total",
+                    help_="sealed-batch replication sends re-attempted "
+                          "after a dead connection or failed dial")
                 time.sleep(0.05 * (tries + 1))
                 self._q.put((target, seq, frame, tries + 1))
                 continue
@@ -453,6 +485,20 @@ class ReplicationManager:
             self._acked[sender] = max(self._acked.get(sender, 0),
                                       int(payload.get("seq") or 0))
             self._synced.notify_all()
+
+    def sync_state(self) -> dict:
+        """Per-peer watermarks: {peer: {"sent", "acked", "lag"}} where lag
+        is the sealed-vs-acked delta in batches — the drain audit
+        (retire_info) and the storage-state fold both read this."""
+        with self._lock:
+            return {t: {"sent": int(s),
+                        "acked": int(self._acked.get(t, 0)),
+                        "lag": max(int(s) - int(self._acked.get(t, 0)), 0)}
+                    for t, s in self._sent.items()}
+
+    def lag(self) -> dict[str, int]:
+        """{peer: unacked batches} (0 = fully synced)."""
+        return {t: st["lag"] for t, st in self.sync_state().items()}
 
     def wait_synced(self, timeout_s: float = 10.0) -> bool:
         """Block until every target acked every enqueued batch (benches and

@@ -73,6 +73,9 @@ flags.define_int(
 
 REC_MAGIC = b"PXJ1"
 _REC_HDR = struct.Struct("<4sII")
+#: px_journal_fsync_seconds bucket bounds: sub-ms (page-cache flush) through
+#: a stalled disk — the PL_JOURNAL_FSYNC=always write-ack tax, measured
+FSYNC_BOUNDS_S = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.25, 1.0)
 #: `batch` policy fsync cadence (also flushed on rotate and close)
 FSYNC_BATCH_RECORDS = 64
 #: hard ceiling on one record's payload (a corrupt length field must not
@@ -81,6 +84,19 @@ MAX_RECORD_BYTES = 1 << 30
 
 
 # ------------------------------------------------------------------ records
+
+
+def _timed_fsync(fh) -> None:
+    """fsync + latency histogram: every acked write pays this under the
+    'always' policy, so its tail IS the ingest durability tax."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    os.fsync(fh.fileno())
+    metrics.histogram_observe(
+        "px_journal_fsync_seconds", _time.perf_counter() - t0,
+        FSYNC_BOUNDS_S,
+        help_="journal fsync latency (the write-ack durability tax)")
 
 
 class _Rec:  # duck-typed HostBatch surface for wire.encode_host_batch
@@ -194,6 +210,18 @@ class TableJournal:
     def _seg_path(self, no: int) -> str:
         return os.path.join(self.dir, f"seg-{no:08d}.jrn")
 
+    def disk_usage(self) -> tuple[int, int]:
+        """(bytes, segments) on disk — the PL_JOURNAL_MAX_MB pruning
+        pressure, surfaced via /healthz detail and storage_state rows."""
+        nbytes = nsegs = 0
+        for p in self.segments():
+            try:
+                nbytes += os.path.getsize(p)
+            except OSError:
+                continue
+            nsegs += 1
+        return nbytes, nsegs
+
     # ------------------------------------------------------------ recover
     def recover(self) -> int:
         """Truncate a torn tail on the NEWEST segment (older segments were
@@ -251,7 +279,7 @@ class TableJournal:
             if policy == "always" or (policy == "batch"
                                       and self._since_fsync
                                       >= FSYNC_BATCH_RECORDS):
-                os.fsync(self._fh.fileno())
+                _timed_fsync(self._fh)
                 self._since_fsync = 0
         metrics.counter_inc("px_journal_appends_total",
                             help_="journal records appended")

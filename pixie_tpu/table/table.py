@@ -491,6 +491,9 @@ class TableStore:
     def __init__(self):
         self._tables: dict[str, Table] = {}
         self._lock = threading.Lock()
+        #: owning shard/agent identity, stamped by Agent / LocalCluster —
+        #: the heat model (table/heat.py) labels per-shard access with it
+        self.node_name = ""
         #: table-creation observers (durability wiring: a tracepoint table
         #: deployed after journal attach must start journaling too); called
         #: OUTSIDE the store lock with the new table

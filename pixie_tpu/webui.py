@@ -128,35 +128,63 @@ a { color: #6cb6ff; text-decoration: none; display: inline-block; width: 240px; 
 <p><a href="/profiles">query profiles (flight recorder)</a></p>
 %s</body></html>"""
 
-#: the query-profile panel (GET /profiles): the flight recorder's own
-#: tables rendered server-side — recent per-query rows with their fast-path
-#: provenance, per-tenant latency, and SLO alert edges, all read from
-#: self_telemetry.* through the normal query path (pixie_tpu.observe)
-_PROFILES_SCRIPT = """
+#: the query-profile panels (GET /profiles): the flight recorder's and the
+#: storage observatory's own tables rendered server-side, all read from
+#: self_telemetry.* through the normal query path (pixie_tpu.observe,
+#: pixie_tpu.table.heat).  Panels are (title, pxl-body) pairs NUMBERED AT
+#: RENDER TIME — appending a pane never renumbers or retouches the others.
+#: Each body ends in px.display(<unique var>, '{title}').
+_PROFILE_PANELS: list = [
+    ("recent query profiles", """\
 df = px.DataFrame(table='self_telemetry.query_profiles')
 df = df[['time_', 'query_id', 'tenant', 'service', 'status', 'wall_ns',
          'exec_ns', 'rows_scanned', 'plan_cache_hit', 'matview_hits',
          'matview_stale', 'batch_size', 'hedged', 'evictions']]
 df = df.head(50)
-px.display(df, '1 recent query profiles')
+px.display(df, '{title}')"""),
+    ("per-tenant latency", """\
 lat = px.DataFrame(table='self_telemetry.query_profiles')
 lat = lat.groupby(['tenant', 'status']).agg(
     queries=('wall_ns', px.count),
     latency_p50=('wall_ns', px.p50),
     latency_p99=('wall_ns', px.p99),
 )
-px.display(lat, '2 per-tenant latency')
+px.display(lat, '{title}')"""),
+    ("slo alert edges", """\
 al = px.DataFrame(table='self_telemetry.alerts')
 al = al.groupby(['slo', 'tenant', 'window', 'state']).agg(
     edges=('burn_rate', px.count),
     max_burn=('burn_rate', px.max),
 )
-px.display(al, '3 slo alert edges')
+px.display(al, '{title}')"""),
+    ("autoscaler decisions", """\
 sc = px.DataFrame(table='self_telemetry.scale_events')
 sc = sc[['time_', 'action', 'agent', 'reason', 'pressure', 'agents']]
 sc = sc.head(30)
-px.display(sc, '4 autoscaler decisions')
-"""
+px.display(sc, '{title}')"""),
+    ("shard heat by tier", """\
+hh = px.DataFrame(table='self_telemetry.shard_heat')
+hh = hh.groupby(['table_name', 'shard', 'tier']).agg(
+    heat=('heat', px.max),
+    rows_scanned=('rows_scanned', px.max),
+    skew=('skew', px.max),
+)
+px.display(hh, '{title}')"""),
+    ("storage state", """\
+st = px.DataFrame(table='self_telemetry.storage_state')
+st = st.groupby(['agent', 'table_name']).agg(
+    hot_rows=('hot_rows', px.max),
+    sealed_batches=('sealed_batches', px.max),
+    sealed_bytes=('sealed_bytes', px.max),
+    journal_bytes=('journal_bytes', px.max),
+    repl_lag=('repl_lag_batches', px.max),
+)
+px.display(st, '{title}')"""),
+]
+
+_PROFILES_SCRIPT = "\n".join(
+    body.format(title=f"{i} {title}")
+    for i, (title, body) in enumerate(_PROFILE_PANELS, 1))
 
 _PROFILES_PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>query profiles — pixie-tpu</title>

@@ -517,6 +517,34 @@ def test_observe_overhead_absolute_ceiling():
     assert bench.absolute_floors(_observe_doc(rows=50_000, frac=0.5)) == []
 
 
+def test_observe_overhead_doc_with_heat_cells_passes_guard():
+    """The data-plane observatory rides the observe_overhead on-arm: the
+    result doc grew a heat_cells field and the ABS ceiling still guards
+    overhead_frac exactly as before."""
+    assert bench.absolute_floors(_observe_doc(heat_cells=12)) == []
+    regs = bench.absolute_floors(_observe_doc(frac=0.07, heat_cells=12))
+    assert [r["key"] for r in regs] == [
+        "configs.observe_overhead.overhead_frac"]
+
+
+def test_observe_overhead_live_run_accounts_heat():
+    """A small live observe_overhead run measures with shard-heat
+    accounting active: the ON arm populates the heat model (heat_cells >
+    0) while the result keeps the guarded shape."""
+    import pixie_tpu.trace  # noqa: F401 — defines PL_TRACING_ENABLED
+    from pixie_tpu import flags
+    from pixie_tpu.table import heat
+
+    saved_tracing = flags.get("PL_TRACING_ENABLED")
+    out = bench.bench_observe_overhead(rows=4000, repeats=4)
+    assert "error" not in out, out
+    assert {"overhead_frac", "on_p50_ms", "off_p50_ms",
+            "samples_per_arm", "heat_cells"} <= set(out)
+    assert out["heat_cells"] > 0
+    assert flags.get("PL_TRACING_ENABLED") == saved_tracing
+    heat.reset_for_testing()
+
+
 def test_observe_overhead_harness_crash_fails_guard():
     """A crashed observe_overhead harness (error marker, overhead_frac
     missing at the guarded shape) FAILS the ceiling instead of silently

@@ -102,6 +102,20 @@ def _self_telemetry_rows(ts):
         "window": "fast", "burn_rate": 20.0, "threshold": 14.4,
         "objective": 0.99, "state": "firing",
     } for i in range(3)])
+    observe.write_rows(ts, observe.SHARD_HEAT_TABLE, [{
+        "time_": 10 ** 15 + i, "table_name": "http_events",
+        "shard": f"pem{i % 2}", "tier": "stream", "age_bucket": "hot",
+        "rows_scanned": 100 * (i + 1), "bytes": 800 * (i + 1),
+        "heat": 50.0 * (i + 1), "skew": 1.2, "last_access": 10 ** 15 + i,
+    } for i in range(6)])
+    observe.write_rows(ts, observe.STORAGE_STATE_TABLE, [{
+        "time_": 10 ** 15 + i, "agent": f"pem{i % 2}",
+        "table_name": "http_events", "hot_rows": 10 * i,
+        "sealed_batches": i, "sealed_bytes": 1000 * i,
+        "age_histogram": "", "resident_bytes": 0, "matview_bytes": 0,
+        "journal_bytes": 100 * i, "journal_segments": 1,
+        "repl_lag_batches": 0, "peer_lag": "",
+    } for i in range(6)])
 
 
 # ---------------------------------------------------------------- unit layer

@@ -82,12 +82,62 @@ def _alert_rows() -> list[dict]:
     return rows
 
 
+def _shard_heat_rows() -> list[dict]:
+    rng = np.random.default_rng(13)
+    rows = []
+    i = 0
+    for table in ("http_events", "conn_stats"):
+        for shard in ("pem0", "pem1", "pem2"):
+            for tier in ("resident", "hbm_cache", "stream"):
+                for bucket in ("hot", "<10m", "old"):
+                    rows.append({
+                        "time_": 100 * SEC + i,
+                        "table_name": table, "shard": shard,
+                        "tier": tier, "age_bucket": bucket,
+                        "rows_scanned": int(rng.integers(100, 10_000)),
+                        "bytes": int(rng.integers(1000, 10**6)),
+                        "heat": round(float(rng.uniform(0, 5000)), 3),
+                        "skew": round(float(rng.uniform(1.0, 2.0)), 3),
+                        "last_access": 100 * SEC + i,
+                    })
+                    i += 1
+    return rows
+
+
+def _storage_state_rows() -> list[dict]:
+    rng = np.random.default_rng(14)
+    rows = []
+    i = 0
+    for agent in ("pem0", "pem1"):
+        for table in ("http_events", "conn_stats"):
+            for _ in range(3):  # three fold cycles; dashboards take max
+                rows.append({
+                    "time_": 100 * SEC + i,
+                    "agent": agent, "table_name": table,
+                    "hot_rows": int(rng.integers(0, 5000)),
+                    "sealed_batches": int(rng.integers(0, 30)),
+                    "sealed_bytes": int(rng.integers(0, 10**7)),
+                    "age_histogram": json.dumps({"<10m": 3, "old": 2}),
+                    "resident_bytes": int(rng.integers(0, 10**6)),
+                    "matview_bytes": int(rng.integers(0, 10**5)),
+                    "journal_bytes": int(rng.integers(0, 10**7)),
+                    "journal_segments": int(rng.integers(0, 8)),
+                    "repl_lag_batches": int(rng.integers(0, 5)),
+                    "peer_lag": json.dumps({"pem9": 1}),
+                })
+                i += 1
+    return rows
+
+
 @pytest.fixture(scope="module")
 def store():
     ts = TableStore()
     observe.write_rows(ts, observe.METRICS_TABLE, _metric_rows())
     observe.write_rows(ts, observe.PROFILES_TABLE, _profile_rows())
     observe.write_rows(ts, observe.ALERTS_TABLE, _alert_rows())
+    observe.write_rows(ts, observe.SHARD_HEAT_TABLE, _shard_heat_rows())
+    observe.write_rows(ts, observe.STORAGE_STATE_TABLE,
+                       _storage_state_rows())
     return ts
 
 
@@ -185,8 +235,45 @@ def test_slo_alerts_golden(store):
     assert_frames(res, exp)
 
 
+# ------------------------------------------------------------- self_storage
+
+
+def test_shard_heat_golden(store):
+    res = _run(store, "self_storage", "shard_heat")
+    df = pd.DataFrame(_shard_heat_rows())
+    exp = df.groupby(["table_name", "shard"], as_index=False).agg(
+        heat=("heat", "sum"),
+        rows_scanned=("rows_scanned", "sum"),
+        bytes=("bytes", "sum"),
+        skew=("skew", "max"))
+    assert_frames(res, exp, approx=("heat",), rtol=1e-9)
+
+
+def test_serving_tiers_golden(store):
+    res = _run(store, "self_storage", "serving_tiers")
+    df = pd.DataFrame(_shard_heat_rows())
+    exp = df.groupby(["table_name", "tier"], as_index=False).agg(
+        rows_scanned=("rows_scanned", "sum"),
+        bytes=("bytes", "sum"))
+    assert_frames(res, exp)
+
+
+def test_storage_state_golden(store):
+    res = _run(store, "self_storage", "storage_state")
+    df = pd.DataFrame(_storage_state_rows())
+    exp = df.groupby(["agent", "table_name"], as_index=False).agg(
+        hot_rows=("hot_rows", "max"),
+        sealed_batches=("sealed_batches", "max"),
+        sealed_bytes=("sealed_bytes", "max"),
+        journal_bytes=("journal_bytes", "max"),
+        resident_bytes=("resident_bytes", "max"),
+        matview_bytes=("matview_bytes", "max"),
+        repl_lag_batches=("repl_lag_batches", "max"))
+    assert_frames(res, exp)
+
+
 def test_vis_json_widgets_cover_every_func():
-    for name in ("self_metrics", "self_slo"):
+    for name in ("self_metrics", "self_slo", "self_storage"):
         import ast
 
         src = (REPO_BUNDLE / name / f"{name}.pxl").read_text()

@@ -191,14 +191,12 @@ def cluster():
 
 def test_broker_distributed_query_matches_local(cluster):
     broker, stores, agents, client = cluster
-    # every agent also carries the self-telemetry tables (spans + the
-    # query flight recorder's profiles/op-stats/metrics/alerts + the
-    # autoscaler's scale-event journal)
-    assert set(client.schemas()) == {
-        "http_events", "self_telemetry.spans",
-        "self_telemetry.query_profiles", "self_telemetry.op_stats",
-        "self_telemetry.metrics", "self_telemetry.alerts",
-        "self_telemetry.scale_events"}
+    # every agent also carries the self-telemetry tables (spans plus the
+    # full observe.SELF_TABLES set) -- derived, so the assert tracks new
+    # self-telemetry tables automatically
+    from pixie_tpu import observe, trace
+    expected = {"http_events", trace.SPANS_TABLE} | set(observe.SELF_TABLES)
+    assert set(client.schemas()) == expected
     res = client.execute_script(SCRIPT)["out"]
     # oracle: LocalCluster over the same stores
     from pixie_tpu.parallel.cluster import LocalCluster

@@ -180,6 +180,46 @@ def test_script_page_404(server):
     assert ei.value.code == 404
 
 
+def test_profiles_page_renders_every_panel():
+    """GET /profiles renders one pane per _PROFILE_PANELS entry — the
+    flight recorder's plus the storage observatory's — with the expected
+    titles derived from the panel list, never a hard-coded count."""
+    from pixie_tpu import observe, webui
+    from pixie_tpu.table import TableStore
+
+    ts = TableStore()
+    observe.write_rows(ts, observe.PROFILES_TABLE, [{
+        "time_": 10 ** 15, "query_id": "q0", "tenant": "t0",
+        "service": "broker", "status": "ok", "wall_ns": 1000}])
+    observe.write_rows(ts, observe.ALERTS_TABLE, [{
+        "time_": 10 ** 15, "slo": "lat", "tenant": "t0", "window": "fast",
+        "burn_rate": 20.0, "threshold": 14.4, "objective": 0.99,
+        "state": "firing"}])
+    observe.write_rows(ts, observe.SCALE_EVENTS_TABLE, [{
+        "time_": 10 ** 15, "action": "scale_up", "agent": "pem1",
+        "reason": "pressure", "pressure": 2.0, "agents": 2}])
+    observe.write_rows(ts, observe.SHARD_HEAT_TABLE, [{
+        "time_": 10 ** 15, "table_name": "http_events", "shard": "pem0",
+        "tier": "stream", "age_bucket": "hot", "rows_scanned": 100,
+        "bytes": 800, "heat": 50.0, "skew": 1.0, "last_access": 10 ** 15}])
+    observe.write_rows(ts, observe.STORAGE_STATE_TABLE, [{
+        "time_": 10 ** 15, "agent": "pem0", "table_name": "http_events",
+        "hot_rows": 100, "sealed_batches": 1, "sealed_bytes": 4096,
+        "age_histogram": "", "resident_bytes": 0, "matview_bytes": 0,
+        "journal_bytes": 123, "journal_segments": 1,
+        "repl_lag_batches": 0, "peer_lag": ""}])
+    srv = LiveServer(local_runner(ts)).start()
+    try:
+        code, body = _get(srv, "/profiles")
+    finally:
+        srv.stop()
+    assert code == 200
+    assert len(webui._PROFILE_PANELS) >= 6
+    for title, _pxl in webui._PROFILE_PANELS:
+        assert title in body, title
+    assert "shard" in body and "journal_bytes" in body
+
+
 @_requires_reference
 def test_run_api_executes_and_renders_widgets(server):
     code, out = _post(server, "/api/run",
