@@ -633,6 +633,25 @@ def bench_elastic_ramp(clients_high, rows=60_000):
     return {k: out[k] for k in keep if k in out}
 
 
+def bench_adaptive_gates(rows=400_000, queries=96):
+    """`adaptive_gates`: the self-driving hot path's A/B proof — a mixed
+    workload (warm dashboards + a raw-rows join) over a 2-agent
+    LocalCluster with PX_CPU_CROSSOVER_ROWS deliberately MIS-tuned, run
+    in alternating interleaved blocks with the adaptive gates OFF (pure
+    static constants) vs ON (engine/autotune.py cost models).  Guarded
+    ABSOLUTELY at the full shape: the fitted models must at least match
+    the static constants (adaptive_vs_static ≥ 1.0), every answer under
+    both arms BIT-equal to the static baseline, ≥ 3 distinct gates
+    actually decided, zero tail-guard fallbacks, and the adaptive p99
+    bounded against the static arm's."""
+    from pixie_tpu.engine.autotune_bench import run_adaptive_gates
+
+    try:
+        return run_adaptive_gates(rows=rows, queries=queries)
+    except Exception as e:  # the bench round must survive a harness failure
+        return {"rows": rows, "error": f"{type(e).__name__}: {e}"[:200]}
+
+
 #: observe_overhead's warm dashboard script (the interactive shape the
 #: flight recorder instruments on every query)
 OBSERVE_SCRIPT = """
@@ -944,6 +963,10 @@ def main():
                     help="replayed queries for the chaos_recovery config")
     ap.add_argument("--elastic-clients", type=int, default=16,
                     help="high-phase closed-loop clients for elastic_ramp")
+    ap.add_argument("--adaptive-rows", type=int, default=400_000,
+                    help="table rows for the adaptive_gates A/B config")
+    ap.add_argument("--adaptive-queries", type=int, default=96,
+                    help="measured queries for the adaptive_gates config")
     ap.add_argument("--smoke", action="store_true", help="tiny shapes, CPU-safe")
     ap.add_argument("--quick", action="store_true", help="small-but-real shapes")
     ap.add_argument("--repeats", type=int, default=3)
@@ -967,6 +990,7 @@ def main():
         args.serving_clients = 60
         args.chaos_queries = 16
         args.elastic_clients = 10
+        args.adaptive_rows, args.adaptive_queries = 24_000, 24
     elif args.quick:
         args.rows, args.sweep = 4_000_000, "1000000,4000000"
         args.stream_rows, args.join_rows, args.dist_rows = (
@@ -975,6 +999,7 @@ def main():
         args.serving_clients = 160
         args.chaos_queries = 40
         args.elastic_clients = 12
+        args.adaptive_rows, args.adaptive_queries = 80_000, 48
 
     from pixie_tpu.table import TableStore
 
@@ -1026,6 +1051,8 @@ def main():
     chaos = bench_chaos_recovery(args.chaos_queries)
     chaos_hard = bench_chaos_recovery_hard(max(args.chaos_queries // 2, 12))
     elastic = bench_elastic_ramp(args.elastic_clients)
+    adaptive = bench_adaptive_gates(args.adaptive_rows,
+                                    args.adaptive_queries)
     sharded = bench_sharded_agg(args.rows, args.repeats)
     cfg3, cfg3_busy = bench_config3(args.join_rows, args.repeats)
     dj_rows = min(args.join_rows, 16_000_000)
@@ -1068,6 +1095,7 @@ def main():
             "chaos_recovery": chaos,
             "chaos_recovery_hard": chaos_hard,
             "elastic_ramp": elastic,
+            "adaptive_gates": adaptive,
             "sharded_agg_64m": sharded,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
             "device_join_unit": {
@@ -1345,6 +1373,14 @@ ABS_FLOORS = [
     ("configs.elastic_ramp.scale_downs", 1.0, 16),
     ("configs.elastic_ramp.preemptions", 1.0, 16),
     ("configs.elastic_ramp.bit_equal_frac", 1.0, 16),
+    # adaptive-gates acceptance (ISSUE 17): against deliberately mis-tuned
+    # static constants the fitted models must at least match (they win in
+    # practice), every answer under both arms must be BIT-equal to the
+    # static baseline, and ≥ 3 distinct gates must have actually decided
+    # or observed — the goodput win has to come from real gate routing
+    ("configs.adaptive_gates.adaptive_vs_static", 1.0, 400_000),
+    ("configs.adaptive_gates.bit_equal_frac", 1.0, 400_000),
+    ("configs.adaptive_gates.gates_decided", 4.0, 400_000),
 ]
 
 #: absolute ceilings (key path, ceiling, shape rows) — the serving
@@ -1379,6 +1415,12 @@ ABS_CEILINGS = [
     ("configs.elastic_ramp.fairness_ratio", 2.0, 16),
     ("configs.elastic_ramp.client_errors", 0.0, 16),
     ("configs.elastic_ramp.p99_ms", 20_000.0, 16),
+    # adaptive gates may not trade the tail for goodput: exploration
+    # probes pay the static arm's cost by construction, so the adaptive
+    # p99 stays near the static arm's; and a healthy run trips ZERO
+    # tail-guard fallbacks (a trip means a model drifted mid-bench)
+    ("configs.adaptive_gates.p99_ratio", 1.25, 400_000),
+    ("configs.adaptive_gates.fallbacks", 0.0, 400_000),
 ]
 
 

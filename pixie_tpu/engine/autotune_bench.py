@@ -1,0 +1,159 @@
+"""Adaptive-gates A/B harness (the `adaptive_gates` bench config).
+
+The proof for the self-driving hot path (engine/autotune.py): the SAME
+mixed workload runs under two arms in alternating interleaved blocks —
+
+  * **static** — ``PX_AUTOTUNE=0``, every gate on its hand-tuned constant,
+    with ``PX_CPU_CROSSOVER_ROWS`` deliberately MIS-tuned for the workload
+    (4096 against ~200k-row scans: the constant says "device", the
+    measurements say "cpu").  This is the realistic failure mode the
+    tentpole exists for — a constant tuned once on one box, wrong here.
+  * **adaptive** — ``PX_AUTOTUNE=1``, the gates route through the online
+    cost models.  After the warmup phase the routing model has measured
+    both arms and steers the agg chains back onto the CPU fast paths the
+    constant priced out.
+
+Guarded absolutely by ``bench.py --check-regressions`` at the full shape:
+``adaptive_vs_static ≥ 1.0`` (the fitted models must at least match the
+mis-tuned constants — in practice they win), ``bit_equal_frac = 1.0``
+(every answer under every arm is BIT-equal to the static baseline,
+canonicalized order-independently: the device-join contract leaves pair
+ORDER unspecified), ``gates_decided ≥ 4`` (the win must come from real
+per-gate decisions, not one lucky constant), fallbacks = 0 and the
+adaptive p99 bounded against the static arm's (exploration probes pay the
+static arm's cost by construction, so the ratio sits near 1.0).
+"""
+from __future__ import annotations
+
+import time
+
+from pixie_tpu import flags
+from pixie_tpu.engine import autotune
+
+#: one raw-rows self-join on the (repeated-across-agents) time column:
+#: ≥ 2^16 rows per side at the full shape, so the merger's join runs
+#: through the device-join gate's autotune decision
+JOIN_SCRIPT = """
+l = px.DataFrame(table='http_events')
+r = px.DataFrame(table='http_events')
+j = l.merge(r, how='inner', left_on='time_', right_on='time_')
+j = j.groupby('service_x').agg(cnt=('latency_x', px.count))
+px.display(j, 'out')
+"""
+
+#: filter-shaped workload with ORDER-INDEPENDENT aggregates (count/max).
+#: chaos_bench's filtered-sum script is excluded by design: a float sum's
+#: bits depend on reduction order, which differs across the cpu/device
+#: routes by construction (~1 ulp, pre-existing) — it can never be part
+#: of an arms-bit-equality proof, while count/max/p50 are exact selections
+FILTER_SCRIPT = """
+df = px.DataFrame(table='http_events')
+df = df[df.status == 500]
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mx=('latency', px.max))
+px.display(df, 'out')
+"""
+
+#: flags the harness overrides and restores
+_FLAGS = ("PX_AUTOTUNE", "PX_CPU_CROSSOVER_ROWS", "PL_MATVIEW_ENABLED")
+
+
+def _pct(xs, q: float) -> float:
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run_adaptive_gates(rows: int = 400_000, queries: int = 96,
+                       blocks: int = 6, warmup: int = 40) -> dict:
+    """Run the A/B comparison; returns the `adaptive_gates` report dict."""
+    import pixie_tpu.matview.maintainer  # noqa: F401 (defines PL_MATVIEW_*)
+    from pixie_tpu.parallel.cluster import LocalCluster
+    from pixie_tpu.services.chaos_bench import (
+        SCRIPTS, _mkstore, canonical_bytes,
+    )
+
+    scripts = list(SCRIPTS[:2]) + [FILTER_SCRIPT, JOIN_SCRIPT]
+    saved = {n: flags.get(n) for n in _FLAGS}
+    t_bench0 = time.perf_counter()
+    autotune.MODEL.reset_for_testing()
+    cluster = None
+    try:
+        # the mis-tuned constant: scans are ~rows/2 per agent, far past
+        # 4096, so the static arm routes every agg chain onto the device
+        # path and pays the jax feed loop where the CPU fast paths
+        # (np_partial / wholeplan native) would have served it
+        flags.set_for_testing("PX_CPU_CROSSOVER_ROWS", 4096)
+        # standing matviews would serve every warm repeat from cached
+        # fragments and never touch the dispatch seam the gates live on —
+        # this bench measures the gates, so every query must execute
+        flags.set_for_testing("PL_MATVIEW_ENABLED", False)
+        stores = {f"pem{i}": _mkstore(i, rows // 2) for i in range(2)}
+        cluster = LocalCluster(stores)
+
+        # ------------------------------------------------ static baseline
+        # (autotune OFF): compiles every plan shape on the static route and
+        # pins the canonical answer each later run must BIT-match
+        flags.set_for_testing("PX_AUTOTUNE", False)
+        base_fp = []
+        for s in scripts:
+            cluster.query(s)  # compile warm
+            base_fp.append(canonical_bytes(cluster.query(s)))
+
+        # -------------------------------------------------- adaptive warm
+        flags.set_for_testing("PX_AUTOTUNE", True)
+        # the kernel-choice model's input: the explicit dense-vs-sorted
+        # crossover probe (ops/sketch.py) — model-only, fed once per round
+        from pixie_tpu.ops.sketch import measure_update_crossover
+
+        measure_update_crossover(n=1 << 16, groups=(128, 256), repeats=1)
+        for i in range(warmup):
+            cluster.query(scripts[i % len(scripts)])
+
+        # ------------------------------------------- interleaved measure
+        per_block = max(1, queries // (blocks * 2))
+        times = {"static": [], "adaptive": []}
+        checks = ok = 0
+        si = 0
+        for _b in range(blocks):
+            for arm in ("static", "adaptive"):
+                flags.set_for_testing("PX_AUTOTUNE", arm == "adaptive")
+                for _ in range(per_block):
+                    idx = si % len(scripts)
+                    si += 1
+                    t0 = time.perf_counter()
+                    res = cluster.query(scripts[idx])
+                    times[arm].append(time.perf_counter() - t0)
+                    checks += 1
+                    ok += canonical_bytes(res) == base_fp[idx]
+
+        snap = autotune.MODEL.snapshot()
+        gates_decided = sum(
+            1 for g in snap.values()
+            if g["decisions"] > 0 or g["samples"] > 0)
+        s_gp = len(times["static"]) / max(sum(times["static"]), 1e-9)
+        a_gp = len(times["adaptive"]) / max(sum(times["adaptive"]), 1e-9)
+        s_p99 = _pct(times["static"], 0.99)
+        return {
+            "rows": rows,
+            "seconds": round(time.perf_counter() - t_bench0, 1),
+            "queries": checks,
+            "static_goodput_qps": round(s_gp, 2),
+            "adaptive_goodput_qps": round(a_gp, 2),
+            "adaptive_vs_static": round(a_gp / max(s_gp, 1e-9), 3),
+            "static_p50_ms": round(_pct(times["static"], 0.5) * 1e3, 1),
+            "adaptive_p50_ms": round(
+                _pct(times["adaptive"], 0.5) * 1e3, 1),
+            "static_p99_ms": round(s_p99 * 1e3, 1),
+            "adaptive_p99_ms": round(
+                _pct(times["adaptive"], 0.99) * 1e3, 1),
+            "p99_ratio": round(
+                _pct(times["adaptive"], 0.99) / max(s_p99, 1e-9), 3),
+            "bit_equal_frac": round(ok / max(checks, 1), 4),
+            "gates_decided": gates_decided,
+            "decisions": sum(g["decisions"] for g in snap.values()),
+            "fallbacks": sum(g["fallbacks"] for g in snap.values()),
+        }
+    finally:
+        for n, v in saved.items():
+            flags.set_for_testing(n, v)
+        autotune.MODEL.reset_for_testing()

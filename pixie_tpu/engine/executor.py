@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pixie_tpu.engine.eval import ExprCompiler, SVal, apply_lut, apply_lut_np
+from pixie_tpu.engine import autotune as _autotune
 from pixie_tpu.engine import resident, transfer
 from pixie_tpu.native import codegen as _codegen
 from pixie_tpu.engine.result import QueryResult
@@ -331,8 +332,11 @@ def _route_backend(src, scale: int = 1) -> str:
     that partial aggregation exists to deliver (round-3 config-4 regression).
     """
     n = _src_rows(src)
-    if n is not None and n * max(1, scale) <= CPU_CROSSOVER_ROWS and \
-            _cpu_device() is not False:
+    # read through the flag registry (not the import-time constant) so the
+    # crossover is live-tunable — the static arm the autotune A/B bench and
+    # the tail-guard fallback both pin against
+    if n is not None and _cpu_device() is not False and \
+            n * max(1, scale) <= int(_flags.get("PX_CPU_CROSSOVER_ROWS")):
         return "cpu"
     return "tpu"
 
@@ -1137,6 +1141,9 @@ class PlanExecutor:
         #: CPU/TPU routing multiplies local input sizes by this so a sharded
         #: query routes by its TOTAL size (see _route_backend).
         self.route_scale = max(1, int(route_scale))
+        #: adaptive-routing decisions taken for this query, one per size
+        #: bucket (engine/autotune.py; empty with PX_AUTOTUNE=0)
+        self._at_route: dict[str, dict] = {}
         #: pin the dispatch backend regardless of input size.  The streaming
         #: executor pins "cpu": every poll delta would re-UPLOAD its rows to
         #: a remote TPU (hot data is host-resident), so size-based routing is
@@ -1172,7 +1179,25 @@ class PlanExecutor:
     def _backend_for(self, src) -> str:
         if self.force_backend is not None:
             return self.force_backend
-        return _route_backend(src, self.route_scale)
+        static = _route_backend(src, self.route_scale)
+        if not _autotune.enabled() or _cpu_device() is False:
+            return static
+        n = _src_rows(src)
+        if n is None:
+            return static
+        # one decision per size bucket per executor: every _backend_for
+        # call for this query's inputs routes consistently (fast paths ask
+        # repeatedly), and stats["autotune"] carries exactly the decisions
+        # this query ran under
+        bucket = _autotune.size_bucket(n * self.route_scale)
+        dec = self._at_route.get(bucket)
+        if dec is None:
+            dec = _autotune.MODEL.decide(
+                _autotune.GATE_CPU_CROSSOVER, "agg", bucket,
+                "cpu" if static == "cpu" else "device", ("cpu", "device"))
+            self._at_route[bucket] = dec
+            self.stats.setdefault("autotune", []).append(dec)
+        return "cpu" if dec["arm"] == "cpu" else "tpu"
 
     def _device_ctx(self, src):
         if self._backend_for(src) == "cpu" and _cpu_device() is not False:
@@ -2235,6 +2260,16 @@ class PlanExecutor:
                         src, names, cap, t_lo, t_hi, luts, fuse_key=sig,
                     )
                 self._feed_rec = None
+        if self._at_route and rec.get("wall_ns"):
+            # fold the measured chain wall into the routing decision that
+            # picked this backend (per-arm cost model, engine/autotune.py)
+            n = _src_rows(src)
+            if n is not None:
+                dec = self._at_route.get(
+                    _autotune.size_bucket(n * self.route_scale))
+                if dec is not None:
+                    _autotune.MODEL.observe_decision(
+                        dec, rec["wall_ns"] / 1e9)
         return keys, udas, state_np, seen_name, in_types, val_dicts
 
     def _wholeplan_program(self, sig, kern, chain, op, keys, init_specs,
@@ -2448,7 +2483,8 @@ class PlanExecutor:
                 bucket = _first_len(cols)
                 first = next(iter(cols.values()))
                 small_np = (isinstance(first, np.ndarray)
-                            and bucket <= CPU_CROSSOVER_ROWS
+                            and bucket <= int(
+                                _flags.get("PX_CPU_CROSSOVER_ROWS"))
                             and _cpu_device() is not False)
                 if small_np and device_merge_ok:
                     # A device-merged query keeps its small feeds (the
@@ -2728,6 +2764,17 @@ class PlanExecutor:
                 continue
             for cid, parent in g:
                 out[cid] = got[parent.id]
+        if out and _autotune.enabled():
+            # record-only gate: the fusion choice is baked into compiled
+            # kernels at trace time, so the model attributes it but never
+            # flips it per query (flipping would churn the program cache —
+            # tuning it from measured wave RTT on accelerator hardware is
+            # the documented ROADMAP remainder)
+            self.stats.setdefault("autotune", []).append({
+                "gate": _autotune.GATE_MQ_FUSION, "plan_class": "agg",
+                "size_bucket": _autotune.size_bucket(len(out)),
+                "arm": "fused", "static_arm": "fused", "source": "static",
+                "model_ms": None, "static_ms": None, "n": len(out)})
         return out
 
     def _multi_partial_agg(self, ops: list) -> Optional[dict]:
@@ -3162,6 +3209,7 @@ class PlanExecutor:
 
         from pixie_tpu.ops import join_device as _jd  # defines the flag
 
+        at_dec = None
         if min(nl, nr) >= (1 << 16):
             # the gate is AUTO by default: measured H2D bandwidth on
             # accelerators, native-kernel availability on CPU — and the
@@ -3169,9 +3217,25 @@ class PlanExecutor:
             gate = _jd.device_join_gate()
             self.stats.setdefault("device", {})["join_gate"] = {
                 k: v for k, v in gate.items() if k != "flag"}
+            if _autotune.enabled() and gate.get("flag") == -1:
+                # under autotune the threshold heuristic becomes the
+                # STATIC arm of a measured device-vs-host cost model;
+                # epsilon probes keep the unfavored arm's cost current.
+                # Both arms return the same matched-pair SET (pair ORDER
+                # is unspecified by the join contract either way).
+                # Forced flag settings (0/1) are never overridden.
+                at_dec = _autotune.MODEL.decide(
+                    _autotune.GATE_DEVICE_JOIN, "join",
+                    _autotune.size_bucket(min(nl, nr)),
+                    "device" if gate["enabled"] else "host",
+                    ("device", "host"))
+                self.stats.setdefault("autotune", []).append(at_dec)
         else:
             gate = {"enabled": False}
-        if gate["enabled"]:
+        use_device = (at_dec["arm"] == "device" if at_dec is not None
+                      else gate["enabled"])
+        t_match0 = _time.perf_counter_ns()
+        if use_device:
             # device radix-bucketed match phase (ops/join_device.py):
             # sentinel out the nulls so they can't match (-1 vs -2), then
             # the device kernel returns the same pair/mask contract
@@ -3184,6 +3248,13 @@ class PlanExecutor:
         else:
             lidx, ridx, l_matched, r_matched = _match_pairs(
                 lc, rc, lnull, rnull)
+        if at_dec is not None:
+            _autotune.MODEL.observe_decision(
+                at_dec, (_time.perf_counter_ns() - t_match0) / 1e9)
+            # joins often run inside repartition-stage executors whose
+            # stats dict is consumed, not forwarded — the event buffer is
+            # the durable telemetry path for this gate
+            _autotune.MODEL.record_row(at_dec)
         lsel, rsel = [lidx], [ridx]
         if op.how in ("left", "outer"):
             lum = np.nonzero(~l_matched)[0]

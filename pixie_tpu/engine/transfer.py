@@ -31,31 +31,121 @@ import time
 import jax
 import numpy as np
 
+from pixie_tpu import flags as _flags
+
+_flags.define_float(
+    "PX_PROBE_MAX_AGE_S", 900.0,
+    "staleness horizon for the memoized environment probes (wave RTT "
+    "floor, H2D bandwidth): a probe older than this re-measures on next "
+    "read, so a long-lived broker tracks its link instead of trusting a "
+    "boot-time figure forever; 0 = never expire (the pre-horizon "
+    "behavior)")
+
 #: measured-probe memo: the RTT floor and H2D bandwidth are environmental
 #: constants of the process (link + runtime), so each (probe, shape,
-#: device) pair measures ONCE per process epoch — call sites used to
+#: device) pair measures ONCE per probe epoch — call sites used to
 #: re-measure independently (bench, the device-join gate), each paying
-#: ~100+ ms of timed transfers.  Results also export as gauges
-#: (px_wave_rtt_floor_ms / px_h2d_bandwidth_mbps) so /metrics carries the
-#: environment a deployment is actually running on.
+#: ~100+ ms of timed transfers.  Entries carry their measurement time and
+#: expire past PX_PROBE_MAX_AGE_S (a tunneled link's bandwidth is NOT a
+#: constant of the process lifetime — routes flap, tunnels degrade);
+#: `invalidate_probes()` is the explicit operator hook.  Results export as
+#: gauges (px_wave_rtt_floor_ms / px_h2d_bandwidth_mbps /
+#: px_probe_age_seconds) so /metrics carries the environment a deployment
+#: is actually running on — and how stale that picture is.
 _PROBE_LOCK = threading.Lock()
 _PROBE_CACHE: dict = {}
 
+#: pxlint lock-discipline: the gauge registrar runs under the probe mutex
+_pxlint_locks_ = {"_register_age_gauge_locked": "_PROBE_LOCK"}
+
+#: bumped on every invalidation/expiry — consumers that cache DECISIONS
+#: derived from a probe (ops/join_device's auto-gate) key on this so a
+#: re-probe re-opens their decision too
+_PROBE_EPOCH = 0
+
+
+def _now() -> float:
+    # staleness clock, isolated for tests (monotonic: wall-clock jumps
+    # must not mass-expire or immortalize the probe cache)
+    return time.monotonic()
+
+
+def probe_epoch() -> int:
+    with _PROBE_LOCK:
+        return _PROBE_EPOCH
+
 
 def _probe_cached(key, measure, refresh: bool):
+    global _PROBE_EPOCH
+    max_age = float(_flags.get("PX_PROBE_MAX_AGE_S"))
     with _PROBE_LOCK:
-        got = None if refresh else _PROBE_CACHE.get(key)
+        got = None
+        if not refresh:
+            hit = _PROBE_CACHE.get(key)
+            if hit is not None:
+                value, ts = hit
+                if max_age > 0 and _now() - ts > max_age:
+                    _PROBE_CACHE.pop(key, None)
+                    _PROBE_EPOCH += 1
+                else:
+                    got = value
     if got is not None:
         return got
     got = measure()
     with _PROBE_LOCK:
-        _PROBE_CACHE[key] = got
+        _PROBE_CACHE[key] = (got, _now())
+        _register_age_gauge_locked()
     return got
 
 
-def reset_probe_cache_for_testing() -> None:
+def _register_age_gauge_locked() -> None:
+    """Export px_probe_age_seconds once a probe exists: per-probe seconds
+    since measurement, the gauge that makes 'how old is the figure the
+    gate is deciding on' observable."""
+    global _AGE_GAUGE
+    if _AGE_GAUGE:
+        return
+    _AGE_GAUGE = True
+    from pixie_tpu import metrics
+
+    def read():
+        now = _now()
+        with _PROBE_LOCK:
+            out = {(("probe", str(k[0])),): round(now - ts, 3)
+                   for k, (_v, ts) in _PROBE_CACHE.items()}
+        return out or {(): 0.0}
+
+    metrics.register_gauge_fn(
+        "px_probe_age_seconds", read,
+        "age of each memoized environment probe (wave RTT / H2D "
+        "bandwidth); probes past PX_PROBE_MAX_AGE_S re-measure on read")
+
+
+_AGE_GAUGE = False
+
+
+def invalidate_probes() -> None:
+    """Drop every memoized probe NOW (operator/ops hook: the link changed —
+    tunnel restarted, topology moved — and waiting out the staleness
+    horizon would gate on dead numbers).  Derived decision caches keyed on
+    probe_epoch() (the device-join auto-gate) re-evaluate on next read."""
+    global _PROBE_EPOCH
     with _PROBE_LOCK:
         _PROBE_CACHE.clear()
+        _PROBE_EPOCH += 1
+    try:
+        from pixie_tpu.ops import join_device
+
+        join_device.reset_gate_for_testing()
+    except Exception:
+        pass  # gate module unused in this process; nothing to re-open
+
+
+def reset_probe_cache_for_testing() -> None:
+    global _PROBE_EPOCH
+    with _PROBE_LOCK:
+        _PROBE_CACHE.clear()
+        _PROBE_EPOCH += 1
 
 #: wave latencies span ~1 ms (local CPU) to seconds (tunneled TPU)
 WAVE_BOUNDS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)

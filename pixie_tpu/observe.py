@@ -64,6 +64,7 @@ ALERTS_TABLE = "self_telemetry.alerts"
 SCALE_EVENTS_TABLE = "self_telemetry.scale_events"
 SHARD_HEAT_TABLE = "self_telemetry.shard_heat"
 STORAGE_STATE_TABLE = "self_telemetry.storage_state"
+AUTOTUNE_TABLE = "self_telemetry.autotune"
 
 PROFILES_RELATION = Relation.of(
     ("time_", DT.TIME64NS, ST.ST_TIME_NS),
@@ -185,6 +186,25 @@ STORAGE_STATE_RELATION = Relation.of(
     ("peer_lag", DT.STRING),
 )
 
+#: adaptive-gate decision stream (engine/autotune.py): every profile-fed
+#: gate decision (and every tail-guard fallback, source="fallback") with
+#: the model inputs that drove it — "why did this query take this path"
+#: is a PxL query, not a debugger session
+AUTOTUNE_RELATION = Relation.of(
+    ("time_", DT.TIME64NS, ST.ST_TIME_NS),
+    ("query_id", DT.STRING),
+    ("gate", DT.STRING),
+    ("plan_class", DT.STRING),
+    ("size_bucket", DT.STRING),
+    ("arm", DT.STRING),
+    ("static_arm", DT.STRING),
+    ("source", DT.STRING),
+    ("model_ms", DT.FLOAT64),
+    ("static_ms", DT.FLOAT64),
+    ("observed_ms", DT.FLOAT64),
+    ("reason", DT.STRING),
+)
+
 SELF_TABLES: dict[str, Relation] = {
     PROFILES_TABLE: PROFILES_RELATION,
     OP_STATS_TABLE: OP_STATS_RELATION,
@@ -193,6 +213,7 @@ SELF_TABLES: dict[str, Relation] = {
     SCALE_EVENTS_TABLE: SCALE_EVENTS_RELATION,
     SHARD_HEAT_TABLE: SHARD_HEAT_RELATION,
     STORAGE_STATE_TABLE: STORAGE_STATE_RELATION,
+    AUTOTUNE_TABLE: AUTOTUNE_RELATION,
 }
 
 
@@ -423,6 +444,16 @@ def build_profile(query_id: str, tenant: str, service: str,
         "chunks_discarded": int(fault.get("chunks_discarded", 0) or 0),
         "degraded": int(bool(serving.get("degraded"))),
     }
+    # adaptive-gate provenance rides the profile as a non-relation key
+    # (write_rows only persists relation columns; the full decision rows
+    # land in self_telemetry.autotune via autotune.rows_from_stats)
+    at = stats.get("autotune") or any(
+        isinstance(s, dict) and s.get("autotune")
+        for s in agents.values())
+    if at:
+        from pixie_tpu.engine import autotune as _autotune
+
+        profile["autotune"] = _autotune.summary_from_stats(stats)
     return profile, op_rows
 
 
@@ -464,6 +495,8 @@ def _provenance_lines(profile: dict) -> list[str]:
     if profile["degraded"]:
         out.append("  degraded dispatch (stale-while-revalidate views, "
                    "narrowed ack window)")
+    if profile.get("autotune"):
+        out.append(f"  autotune: {profile['autotune']}")
     return out
 
 

@@ -387,6 +387,10 @@ def join_path() -> str:
 
 _gate_lock = threading.Lock()
 _gate_cache: dict | None = None
+#: transfer.probe_epoch() at the cached decision: a probe expiry or
+#: explicit invalidate_probes() bumps the epoch, which re-opens the gate
+#: decision too (it was derived from the now-dead H2D figure)
+_gate_epoch: int = -1
 
 
 def device_join_gate(refresh: bool = False) -> dict:
@@ -405,13 +409,17 @@ def device_join_gate(refresh: bool = False) -> dict:
     px_h2d_bandwidth_mbps are set as a side effect so the gate is
     observable (the executor also records it in stats["device"]).
     """
-    global _gate_cache
+    global _gate_cache, _gate_epoch
+    from pixie_tpu.engine import transfer as _transfer
+
     with _gate_lock:
         flag = flags.get("PX_DEVICE_JOIN")
         # forced settings are never cached (tests flip the flag; no probe
-        # needed anyway) — only the measured auto decision is
+        # needed anyway) — only the measured auto decision is, and only
+        # while the probe epoch it was derived from is still current
         if _gate_cache is not None and not refresh \
-                and _gate_cache.get("flag") == flag:
+                and _gate_cache.get("flag") == flag \
+                and _gate_epoch == _transfer.probe_epoch():
             return _gate_cache
         out = {"flag": flag, "path": join_path()}
         if flag == 0:
@@ -446,6 +454,7 @@ def device_join_gate(refresh: bool = False) -> dict:
         # gauge), so the gate no longer re-measures or re-exports it
         if flag == -1:
             _gate_cache = out
+            _gate_epoch = _transfer.probe_epoch()
         return out
 
 

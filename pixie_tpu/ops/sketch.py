@@ -70,6 +70,17 @@ def _sort_min_groups(backend: str) -> int:
     v = flags.get("PX_SKETCH_SORT_MIN_GROUPS")
     if v > 0:
         return v
+    from pixie_tpu.engine import autotune as _autotune
+
+    if _autotune.enabled():
+        # kernel-choice model: measure_update_crossover feeds both kernels'
+        # measured costs per group count into the model; once it has a
+        # fitted crossover for this backend the hand-measured default
+        # retires.  Model-only (no per-query probe): the dispatch is baked
+        # into compiled programs at trace time.
+        fitted = _autotune.MODEL.sketch_threshold(backend)
+        if fitted is not None:
+            return fitted
     return 4097 if backend == "tpu" else 512
 
 
@@ -315,5 +326,14 @@ def measure_update_crossover(n: int = 1 << 21, groups=(128, 256, 512, 1024),
         points[g] = out
         if crossover is None and out["sorted_ms"] < out["dense_ms"]:
             crossover = g
+        from pixie_tpu.engine import autotune as _autotune
+
+        if _autotune.enabled():
+            # each measured point feeds the kernel-choice model: once every
+            # probed group count is warm, _sort_min_groups serves the
+            # fitted crossover instead of the hand-measured default
+            for _ in range(int(flags.get("PX_AUTOTUNE_MIN_SAMPLES"))):
+                _autotune.MODEL.observe_sketch(
+                    backend, g, out["dense_ms"], out["sorted_ms"])
     return {"backend": backend, "rows": n, "points": points,
             "crossover": crossover}

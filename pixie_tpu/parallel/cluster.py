@@ -315,7 +315,11 @@ class LocalCluster:
         import time as _time
 
         from pixie_tpu import trace as _trace
+        from pixie_tpu.engine import autotune as _autotune
 
+        if _autotune.enabled():
+            # arrival-rate signal for the batch-window controller
+            _autotune.MODEL.observe_arrival()
         prof_on = _trace.enabled() or explain
         prof: dict = {}
         t0 = _time.perf_counter_ns()
@@ -363,6 +367,7 @@ class LocalCluster:
             "phases": prof.get("phases") or {},
             "fastpath": prof.get("fastpath") or {},
             "batch": es.get("batch") or {},
+            "autotune": es.get("autotune") or [],
         }
         c = _trace.current()
         qid = c[1].trace_id if c is not None else _secrets.token_hex(16)
@@ -380,6 +385,15 @@ class LocalCluster:
         if _trace.enabled():
             self._telemetry.add(_observe.PROFILES_TABLE, [profile])
             self._telemetry.add(_observe.OP_STATS_TABLE, op_rows)
+            from pixie_tpu.engine import autotune as _autotune
+
+            if _autotune.enabled():
+                # per-query decisions + pending model events (the
+                # LocalCluster analog of the broker's self-metrics cron)
+                at_rows = _autotune.rows_from_stats(stats, qid)
+                at_rows += _autotune.MODEL.drain_rows()
+                if at_rows:
+                    self._telemetry.add(_observe.AUTOTUNE_TABLE, at_rows)
             _slo.record_query(tenant or "", wall_ns / 1e9, not error)
             if _slo.configured():
                 # same contract as the broker's per-query hook: burn-rate
@@ -491,16 +505,30 @@ class LocalCluster:
             if self._batcher is None:
                 self._batcher = batching.BatchCollector()
             batcher = self._batcher
+        from pixie_tpu.engine import autotune as _autotune
+
+        window_s = float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3
+        max_n = int(_flags.get("PL_BATCH_MAX_QUERIES"))
+        at_dec = None
+        if _autotune.enabled():
+            # rendezvous window from measured wave RTT, member cap from
+            # the measured arrival rate; clamped to a 4x band around the
+            # operator's constants
+            window_s, max_n, at_dec = _autotune.MODEL.batch_window(
+                window_s, max_n)
         got = batching.gate(
-            batcher, q.plan, key, fp,
-            float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3,
-            int(_flags.get("PL_BATCH_MAX_QUERIES")),
+            batcher, q.plan, key, fp, window_s, max_n,
             lambda members: self._execute_batch(members, fp),
             wait_timeout_s=600.0,  # no per-query timeout here: bounded by
             # the leader's own execution, generously
             tenant=tenant, registry=self.registry,
             concurrency=lambda: self._query_inflight >= 2)
-        return got[0] if isinstance(got, tuple) else got
+        res = got[0] if isinstance(got, tuple) else got
+        if at_dec is not None and isinstance(res, dict):
+            for qr in res.values():
+                qr.exec_stats["autotune"] = list(
+                    qr.exec_stats.get("autotune") or []) + [at_dec]
+        return res
 
     def _execute_batch(self, members: list, fp) -> list:
         """Leader path: merge the member plans (shared scans, deduped
@@ -523,7 +551,16 @@ class LocalCluster:
             return dp, {}
 
         (dp, _extras), _hit = _QPC.get_split(slot, fp, _split)
+        import time as _time
+
+        from pixie_tpu.engine import autotune as _autotune
+
+        t0 = _time.perf_counter_ns()
         results = self.execute(slot.fused, dp=dp, tenant="")
+        if _autotune.enabled():
+            # measured fused-wave wall → the batch-window controller
+            _autotune.MODEL.observe_batch_wave(
+                (_time.perf_counter_ns() - t0) / 1e9, len(members))
         batching.note_formed(len(members))
         out = []
         for i, _m in enumerate(members):

@@ -195,6 +195,15 @@ class Agent:
                            observe.sample_metrics_rows(self.name))
         _heat.fold_into(self.store, self.name, matviews=self.matviews,
                         replication=self.replication)
+        from pixie_tpu.engine import autotune as _autotune
+
+        if _autotune.enabled():
+            # adaptive-gate events raised in THIS process (fallback trips,
+            # fitted-threshold changes) land in the local store's slice of
+            # the autotune table on the same cadence as the metrics fold
+            rows = _autotune.MODEL.drain_rows()
+            if rows:
+                observe.write_rows(self.store, observe.AUTOTUNE_TABLE, rows)
 
     def stop(self):
         self._stop.set()

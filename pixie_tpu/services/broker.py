@@ -22,6 +22,7 @@ from typing import Optional
 
 from pixie_tpu import flags as _flags
 from pixie_tpu import trace
+from pixie_tpu.engine import autotune as _autotune
 from pixie_tpu.engine.executor import HostBatch, PlanExecutor
 from pixie_tpu.engine.result import QueryResult
 from pixie_tpu.parallel.distributed import DistributedPlanner
@@ -506,6 +507,11 @@ class Broker:
             # them into the serving front so quota writes survive broker
             # restart (the PL_TENANT_* env specs stay the defaults)
             self._load_quotas()
+            # recall the persisted adaptive-gate model (engine/autotune.py,
+            # same KV pattern as quotas) so a restarted broker's gates
+            # start warm — its first queries pay no cold exploration burst
+            if _autotune.enabled():
+                _autotune.MODEL.load_kv(self.kv)
             #: optional LeaderElector (services/election.py): when set, this
             #: broker only serves queries while holding the lease — a standby
             #: broker sharing the KV takes over when the leader dies
@@ -608,6 +614,9 @@ class Broker:
         self.serving.detach_gauges()
         self.ratemodel.detach_gauges()
         _metrics.unregister_gauge_fn("px_broker_live_agents")
+        if _autotune.enabled():
+            # final checkpoint: the next broker on this KV starts warm
+            _autotune.MODEL.save_kv(self.kv)
         self.kv.close()
 
     def _expiry_loop(self):
@@ -1207,17 +1216,22 @@ class Broker:
         conn.state["incarnation"] = self.registry.incarnation(name)
         old = self._agent_conns.get(name)
         self._agent_conns[name] = conn
-        conn.send(wire.encode_json({"msg": "registered", "asid": asid}))
         if old is not None and old is not conn:
-            # keep "agent"+"incarnation" on the old conn so frames its
-            # reader already queued are FENCED (stale incarnation) rather
-            # than processed; the superseded marker keeps its close from
+            # fence the old socket BEFORE acking the new registration:
+            # once the agent sees "registered" the rejoin is observable,
+            # so the supersede marker must already be set.  Keep
+            # "agent"+"incarnation" on the old conn so frames its reader
+            # already queued are FENCED (stale incarnation) rather than
+            # processed; the superseded marker keeps its close from
             # killing the new registration
             old.state["superseded"] = True
             old.close()
+        conn.send(wire.encode_json({"msg": "registered", "asid": asid}))
+        if old is not None and old is not conn:
             # in-flight dispatches on the old socket are orphaned (the new
             # process never saw them): evict so they re-dispatch to the
-            # fresh incarnation
+            # fresh incarnation (after the ack, so any re-dispatch frame
+            # follows "registered" on the new socket)
             self._evict_agent(name, "superseded")
         # topology changed: replicas retarget, rehydrated shards leave
         # catch-up, takeover materializations for this name invalidate
@@ -1297,6 +1311,11 @@ class Broker:
         (hedge deadlines derive from it)."""
         import time as _time
 
+        if _autotune.enabled():
+            # the same completion stream feeds the fleet-wide hedge-floor
+            # model (engine/autotune.py): measured service p99 replaces
+            # the fixed PL_HEDGE_MIN_MS once warm
+            _autotune.MODEL.observe_service(secs)
         a = 0.2
         with self._svc_lock:
             s = self._svc.get(agent)
@@ -1322,8 +1341,15 @@ class Broker:
             if s is None or s["n"] < HEDGE_MIN_SAMPLES:
                 return None
             p99 = s["ewma"] + 4.0 * s["dev"]
-        return max(float(_flags.get("PL_HEDGE_MIN_MS")) / 1e3,
-                   float(_flags.get("PL_HEDGE_FACTOR")) * p99)
+        floor = float(_flags.get("PL_HEDGE_MIN_MS")) / 1e3
+        if _autotune.enabled():
+            # adaptive floor: the measured fleet service p99 (with
+            # headroom) replaces the fixed half-second constant once the
+            # model is warm.  It only ever LOWERS the operator's floor —
+            # a fast fleet hedges stragglers in tens of ms; the tail guard
+            # snaps back to the static floor if the model drifts.
+            floor, _dec = _autotune.MODEL.hedge_floor_s(floor)
+        return max(floor, float(_flags.get("PL_HEDGE_FACTOR")) * p99)
 
     def _handle_exec_done(self, meta: dict):
         ctx = self._ctx(meta)
@@ -1461,6 +1487,14 @@ class Broker:
             mon = _slo.monitor()
             mon.evaluate()
             self._telemetry.add(_observe.ALERTS_TABLE, mon.drain_alerts())
+        if _autotune.enabled():
+            # fallback trips and fitted-threshold changes → the autotune
+            # telemetry table; checkpoint the model so a crash between
+            # crons loses at most one period of learning
+            rows = _autotune.MODEL.drain_rows()
+            if rows:
+                self._telemetry.add(_observe.AUTOTUNE_TABLE, rows)
+            _autotune.MODEL.save_kv(self.kv)
         self._ship_spans()
 
     def _deploy_mutations(self, mutations: list) -> None:
@@ -2018,6 +2052,11 @@ class Broker:
         if trace.enabled():
             self._telemetry.add(_observe.PROFILES_TABLE, [profile])
             self._telemetry.add(_observe.OP_STATS_TABLE, op_rows)
+            if _autotune.enabled():
+                at_rows = _autotune.rows_from_stats(
+                    stats, profile.get("query_id", ""))
+                if at_rows:
+                    self._telemetry.add(_observe.AUTOTUNE_TABLE, at_rows)
 
     def _execute_script_inner(
         self, script, func, func_args, now, default_limit, analyze,
@@ -2034,6 +2073,9 @@ class Broker:
             leader = self.elector.leader()
             raise Unavailable(
                 f"this broker is not the leader (current leader: {leader})")
+        if _autotune.enabled():
+            # arrival-rate signal for the batch-window controller
+            _autotune.MODEL.observe_arrival()
         # Hold for shards whose agent died moments ago and may re-register
         # (kill-and-restart): planning through the gap would silently serve
         # a reduced topology
@@ -2141,10 +2183,17 @@ class Broker:
         reg = self.udf_registry
         if reg is None:
             from pixie_tpu.udf import registry as reg
+        window_s = float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3
+        max_n = int(_flags.get("PL_BATCH_MAX_QUERIES"))
+        at_dec = None
+        if _autotune.enabled():
+            # rendezvous window from measured wave RTT, member cap from the
+            # measured arrival rate (engine/autotune.py batch controller);
+            # both clamped to a 4x band around the operator's constants
+            window_s, max_n, at_dec = _autotune.MODEL.batch_window(
+                window_s, max_n)
         got = batching.gate(
-            self._batcher, q.plan, key, topo_epoch,
-            float(_flags.get("PL_BATCH_WINDOW_MS")) / 1e3,
-            int(_flags.get("PL_BATCH_MAX_QUERIES")),
+            self._batcher, q.plan, key, topo_epoch, window_s, max_n,
             lambda members: self._execute_batch(members, spec, topo_epoch,
                                                 failover, reg),
             wait_timeout_s=self.query_timeout_s + 30.0,
@@ -2158,6 +2207,12 @@ class Broker:
         if got is None:
             return None
         results, stats = got
+        if at_dec is not None and isinstance(stats, dict):
+            # fresh list, not setdefault: fused-member stats share inner
+            # structures across the batch — appending in place would leak
+            # this member's decision into every sibling's stats
+            stats = dict(stats)
+            stats["autotune"] = list(stats.get("autotune") or []) + [at_dec]
         b = (stats or {}).get("batch") or {}
         if b.get("t0_unix_ns"):
             # ONE batch_exec span under every member's query root (leaders
@@ -2204,6 +2259,9 @@ class Broker:
             extra_verify=lambda dp: planverify.maybe_verify_fused_batch(
                 dp, slot.sink_map))
         wall_ns = _time.time_ns() - t0_ns
+        if _autotune.enabled():
+            # measured fused-wave wall → the batch-window controller
+            _autotune.MODEL.observe_batch_wave(wall_ns / 1e9, k)
         batching.note_formed(k)
         out = []
         for i, m in enumerate(members):

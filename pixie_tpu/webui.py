@@ -180,6 +180,13 @@ st = st.groupby(['agent', 'table_name']).agg(
     repl_lag=('repl_lag_batches', px.max),
 )
 px.display(st, '{title}')"""),
+    ("adaptive gate decisions", """\
+at = px.DataFrame(table='self_telemetry.autotune')
+at = at.groupby(['gate', 'plan_class', 'size_bucket', 'arm', 'source']).agg(
+    decisions=('observed_ms', px.count),
+    observed_p50=('observed_ms', px.p50),
+)
+px.display(at, '{title}')"""),
 ]
 
 _PROFILES_SCRIPT = "\n".join(

@@ -632,3 +632,63 @@ def test_elastic_ramp_harness_crash_fails_guards():
     assert len(regs) >= 7
     assert all(r["key"].startswith("configs.elastic_ramp") for r in regs)
     assert all(r.get("missing") for r in regs)
+
+
+# --------------------------------------------------------- adaptive_gates
+
+
+def _adaptive_doc(rows=400_000, ratio=1.3, bit_equal=1.0, gates=4,
+                  p99_ratio=1.0, fallbacks=0):
+    doc = _doc()
+    doc["configs"]["adaptive_gates"] = {
+        "rows": rows, "queries": 96, "static_goodput_qps": 5.3,
+        "adaptive_goodput_qps": 5.3 * ratio, "adaptive_vs_static": ratio,
+        "static_p50_ms": 100.0, "adaptive_p50_ms": 32.0,
+        "static_p99_ms": 550.0, "adaptive_p99_ms": 550.0 * p99_ratio,
+        "p99_ratio": p99_ratio, "bit_equal_frac": bit_equal,
+        "gates_decided": gates, "decisions": 330, "fallbacks": fallbacks,
+    }
+    return doc
+
+
+def test_adaptive_gates_absolute_guards():
+    """ISSUE-17 acceptance held by CI: against deliberately mis-tuned
+    static constants the fitted models must at least match (ratio >= 1.0),
+    every answer BIT-equal between arms, >= 4 distinct gates actually
+    decided, zero tail-guard fallbacks, and a bounded adaptive p99."""
+    assert bench.absolute_floors(_adaptive_doc()) == []
+    assert [r["key"] for r in bench.absolute_floors(
+        _adaptive_doc(ratio=0.95))] == [
+        "configs.adaptive_gates.adaptive_vs_static"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _adaptive_doc(bit_equal=0.99))] == [
+        "configs.adaptive_gates.bit_equal_frac"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _adaptive_doc(gates=3))] == [
+        "configs.adaptive_gates.gates_decided"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _adaptive_doc(p99_ratio=1.4))] == [
+        "configs.adaptive_gates.p99_ratio"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _adaptive_doc(fallbacks=2))] == [
+        "configs.adaptive_gates.fallbacks"]
+    # the guards ride compare_bench (the CI entry point) too
+    assert bench.compare_bench(_adaptive_doc(), _adaptive_doc(ratio=0.5),
+                               threshold=0.15)
+    # smoke/quick shapes never trip the full-shape bounds
+    assert bench.absolute_floors(
+        _adaptive_doc(rows=24_000, ratio=0.5, bit_equal=0.0, gates=0,
+                      fallbacks=9)) == []
+
+
+def test_adaptive_gates_harness_crash_fails_guards():
+    """A crashed adaptive harness at the guarded shape must TRIP the
+    absolute bounds (missing-key rule), never silently disable the
+    self-driving hot path's CI proof."""
+    doc = _doc()
+    doc["configs"]["adaptive_gates"] = {"rows": 400_000, "error": "boom"}
+    regs = bench.absolute_floors(doc)
+    assert len(regs) == 5
+    assert all(r["key"].startswith("configs.adaptive_gates") for r in regs)
+    assert all(r.get("missing") for r in regs)
+    assert "boom" in bench._format_regression(regs[0])
