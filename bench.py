@@ -633,6 +633,30 @@ def bench_elastic_ramp(clients_high, rows=60_000):
     return {k: out[k] for k in keep if k in out}
 
 
+def bench_elastic_rebalance(clients_high, rows=60_000):
+    """`elastic_rebalance`: the data-lifecycle proof (ROADMAP item 2) — an
+    UNEVEN cluster (one agent carries a hot extra table, one spare sits
+    empty) under a 3-cycle diurnal ramp with the RebalanceController and
+    the compressed cold tier live.  The guard block holds ABSOLUTELY: the
+    hot shard re-homes onto the spare (moves ≥ 1) and the shard-heat
+    outlier settles under the trigger (skew_final), zero rows are lost and
+    every answered query is bit-equal across the move (row_loss,
+    bit_equal_frac), the cold tier demoted sealed batches to compressed
+    disk (demotions ≥ 1) while the in-RAM sealed footprint stayed bounded
+    (hot_ram_peak_mb), and no client saw an error."""
+    from pixie_tpu.services.rebalance_bench import run_elastic_rebalance
+
+    try:
+        out = run_elastic_rebalance(clients_high=clients_high, rows=rows)
+    except Exception as e:  # the bench round must survive a harness failure
+        return {"rows": clients_high, "error": f"{type(e).__name__}: {e}"[:200]}
+    keep = ("rows", "duration_s", "queries", "goodput_qps", "p99_ms",
+            "client_errors", "bit_equal_frac", "moves", "move_refusals",
+            "skew_final", "skew_mean_final", "row_loss", "rows_total",
+            "demotions", "hot_ram_peak_mb", "agents_final")
+    return {k: out[k] for k in keep if k in out}
+
+
 def bench_adaptive_gates(rows=400_000, queries=96):
     """`adaptive_gates`: the self-driving hot path's A/B proof — a mixed
     workload (warm dashboards + a raw-rows join) over a 2-agent
@@ -963,6 +987,9 @@ def main():
                     help="replayed queries for the chaos_recovery config")
     ap.add_argument("--elastic-clients", type=int, default=16,
                     help="high-phase closed-loop clients for elastic_ramp")
+    ap.add_argument("--rebalance-clients", type=int, default=12,
+                    help="high-phase closed-loop clients for "
+                         "elastic_rebalance")
     ap.add_argument("--adaptive-rows", type=int, default=400_000,
                     help="table rows for the adaptive_gates A/B config")
     ap.add_argument("--adaptive-queries", type=int, default=96,
@@ -990,6 +1017,7 @@ def main():
         args.serving_clients = 60
         args.chaos_queries = 16
         args.elastic_clients = 10
+        args.rebalance_clients = 8  # off the guarded shape (rows=12)
         args.adaptive_rows, args.adaptive_queries = 24_000, 24
     elif args.quick:
         args.rows, args.sweep = 4_000_000, "1000000,4000000"
@@ -999,6 +1027,7 @@ def main():
         args.serving_clients = 160
         args.chaos_queries = 40
         args.elastic_clients = 12
+        args.rebalance_clients = 10  # off the guarded shape (rows=12)
         args.adaptive_rows, args.adaptive_queries = 80_000, 48
 
     from pixie_tpu.table import TableStore
@@ -1051,6 +1080,7 @@ def main():
     chaos = bench_chaos_recovery(args.chaos_queries)
     chaos_hard = bench_chaos_recovery_hard(max(args.chaos_queries // 2, 12))
     elastic = bench_elastic_ramp(args.elastic_clients)
+    rebalance = bench_elastic_rebalance(args.rebalance_clients)
     adaptive = bench_adaptive_gates(args.adaptive_rows,
                                     args.adaptive_queries)
     sharded = bench_sharded_agg(args.rows, args.repeats)
@@ -1095,6 +1125,7 @@ def main():
             "chaos_recovery": chaos,
             "chaos_recovery_hard": chaos_hard,
             "elastic_ramp": elastic,
+            "elastic_rebalance": rebalance,
             "adaptive_gates": adaptive,
             "sharded_agg_64m": sharded,
             "3_flow_join": {"rows_per_sec": round(cfg3), "rows": args.join_rows},
@@ -1373,6 +1404,14 @@ ABS_FLOORS = [
     ("configs.elastic_ramp.scale_downs", 1.0, 16),
     ("configs.elastic_ramp.preemptions", 1.0, 16),
     ("configs.elastic_ramp.bit_equal_frac", 1.0, 16),
+    # data-lifecycle acceptance (ROADMAP item 2): under the uneven-fleet
+    # diurnal ramp the hot shard must actually have re-homed (moves ≥ 1),
+    # the cold tier must actually have demoted sealed batches to
+    # compressed disk (demotions ≥ 1), and every answer across the move
+    # must be bit-equal to the fixed-placement baseline
+    ("configs.elastic_rebalance.moves", 1.0, 12),
+    ("configs.elastic_rebalance.demotions", 1.0, 12),
+    ("configs.elastic_rebalance.bit_equal_frac", 1.0, 12),
     # adaptive-gates acceptance (ISSUE 17): against deliberately mis-tuned
     # static constants the fitted models must at least match (they win in
     # practice), every answer under both arms must be BIT-equal to the
@@ -1415,6 +1454,14 @@ ABS_CEILINGS = [
     ("configs.elastic_ramp.fairness_ratio", 2.0, 16),
     ("configs.elastic_ramp.client_errors", 0.0, 16),
     ("configs.elastic_ramp.p99_ms", 20_000.0, 16),
+    # data-lifecycle acceptance (ROADMAP item 2): after the 3-cycle ramp
+    # the shard-heat outlier sits at or under the rebalance trigger, ZERO
+    # acknowledged rows were lost across the move + demotions, no client
+    # saw an error, and the cold ceiling held the in-RAM sealed footprint
+    ("configs.elastic_rebalance.skew_final", 1.3, 12),
+    ("configs.elastic_rebalance.row_loss", 0.0, 12),
+    ("configs.elastic_rebalance.client_errors", 0.0, 12),
+    ("configs.elastic_rebalance.hot_ram_peak_mb", 3.0, 12),
     # adaptive gates may not trade the tail for goodput: exploration
     # probes pay the static arm's cost by construction, so the adaptive
     # p99 stays near the static arm's; and a healthy run trips ZERO

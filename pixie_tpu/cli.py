@@ -464,18 +464,49 @@ def cmd_storage(args) -> int:
             continue
         print(f"-- agent {name} storage state:")
         print(f"   {'table':<34} {'hot':>8} {'sealed':>7} {'bytes':>10} "
-              f"{'journal':>10} {'resident':>10} {'matview':>10} "
-              f"{'lag':>4}  ages")
+              f"{'cold':>10} {'cseg':>5} {'journal':>10} {'resident':>10} "
+              f"{'matview':>10} {'lag':>4}  ages")
         for r in rep.get("storage_state") or []:
             print(f"   {str(r.get('table_name', ''))[:34]:<34} "
                   f"{r.get('hot_rows', 0):>8} "
                   f"{r.get('sealed_batches', 0):>7} "
                   f"{_fmt_bytes(r.get('sealed_bytes', 0)):>10} "
+                  f"{_fmt_bytes(r.get('cold_bytes', 0)):>10} "
+                  f"{r.get('cold_segments', 0):>5} "
                   f"{_fmt_bytes(r.get('journal_bytes', 0)):>10} "
                   f"{_fmt_bytes(r.get('resident_bytes', 0)):>10} "
                   f"{_fmt_bytes(r.get('matview_bytes', 0)):>10} "
                   f"{r.get('repl_lag_batches', 0):>4}  "
                   f"{r.get('age_histogram', '') or '-'}")
+    return 0
+
+
+def cmd_rehome(args) -> int:
+    """Operator shard re-homing: move a hot or draining agent's sealed
+    shard data onto a peer over the replication channel, verify coverage,
+    flip the shard map.  A refused move (printed reason) means ownership
+    never left the donor."""
+    from pixie_tpu.services.client import Client, QueryError
+
+    host, port = args.broker.rsplit(":", 1)
+    client = Client(host, int(port), auth_token=args.auth_token)
+    try:
+        res = client.rehome(args.agent, target=args.target,
+                            reason=args.reason)
+    except QueryError as e:
+        raise SystemExit(f"rehome: {e}") from None
+    finally:
+        client.close()
+    if not res.get("ok"):
+        print(f"rehome refused: {res.get('reason')} "
+              f"(ownership stays with {args.agent})")
+        return 1
+    tables = res.get("tables") or {}
+    print(f"re-homed {res.get('donor')} -> {res.get('target')}: "
+          f"{len(tables)} table(s)")
+    for name in sorted(tables):
+        f = tables[name]
+        print(f"   {name}: rows [{f.get('first', 0)}, {f.get('last', 0)})")
     return 0
 
 
@@ -580,6 +611,18 @@ def main(argv=None) -> int:
     st.add_argument("--broker", required=True, help="host:port")
     st.add_argument("--auth-token", default=None)
     st.set_defaults(fn=cmd_storage)
+
+    rh = sub.add_parser("rehome",
+                        help="move an agent's shard onto a peer (verified "
+                             "two-phase; refused moves change nothing)")
+    rh.add_argument("agent", help="donor agent name")
+    rh.add_argument("--target", default=None,
+                    help="receiving agent (default: broker picks a live "
+                         "replica, else the least-loaded live peer)")
+    rh.add_argument("--reason", default="manual")
+    rh.add_argument("--broker", required=True, help="host:port")
+    rh.add_argument("--auth-token", default=None)
+    rh.set_defaults(fn=cmd_rehome)
 
     ag = sub.add_parser("agent", help="start an agent")
     ag.add_argument("--name", required=True)

@@ -1361,14 +1361,22 @@ class PlanExecutor:
         if isinstance(src, HostBatch):
             return True
         target = max(cap, FEED_ROWS)
+        cold_gens = getattr(src, "cold_gens", None) or frozenset()
+        # metadata iteration: sizing feeds must never materialize data —
+        # iter_meta answers from counts, so a mostly-cold retention window
+        # costs zero decodes here
+        meta = (src.iter_meta() if hasattr(src, "iter_meta")
+                else ((rb.num_valid, rid, gen) for rb, rid, gen in src))
         feeds = pend_rows = 0
-        for rb, _row_id, gen in src:
-            n = rb.num_valid
+        pend_cold = False
+        for n, _row_id, gen in meta:
             if n == 0:
                 continue
-            if gen is None and pend_rows:
+            is_cold = gen in cold_gens
+            if pend_rows and (gen is None or is_cold != pend_cold):
                 feeds += 1
                 pend_rows = 0
+            pend_cold = is_cold
             pend_rows += n
             if pend_rows >= target:
                 feeds += 1
@@ -1409,12 +1417,17 @@ class PlanExecutor:
         # the tracing master switch — flag-off never touches the model.
         heat_rec = self._heat_recorder(src)
 
-        def emit(parts, gens, n):
+        def emit(parts, gens, n, cold=False):
             # Sealed-only feeds are immutable → serve/place them from the HBM
             # feed cache; anything touching the hot remainder streams fresh.
             # CPU-routed queries keep feeds as (cached) numpy — device_put to
             # TPU would commit the inputs there and defeat the routing.
-            cacheable = (all(g is not None for g in gens)
+            # Cold-tier feeds are decode-on-read by design: caching them
+            # (resident or HBM) would promote through the back door and pin
+            # the demoted window in memory — promotion is the cold tier's
+            # explicit, read-heat-driven call.
+            cacheable = (not cold
+                         and all(g is not None for g in gens)
                          and not getattr(src, "is_delta", False))
             if cacheable and backend == "tpu":
                 # Pinned-resident tier first: unlike the gen-tuple-keyed HBM
@@ -1488,30 +1501,44 @@ class PlanExecutor:
                     self.stats.get("h2d_bytes", 0)
                     + sum(v.nbytes for v in cols.values()))
             if heat_rec is not None:
-                heat_rec.record(parts, gens, "stream")
+                heat_rec.record(parts, gens, "cold" if cold else "stream")
+            if cold:
+                # read-heat promotion hook (data-plane, not gated on the
+                # observe switch): enough decodes of the same cold batch
+                # move it back to RAM (PL_COLD_PROMOTE_READS)
+                tier = getattr(src.table, "cold", None)
+                if tier is not None:
+                    tier.note_reads(gens)
             return cols, n
 
+        cold_gens = getattr(src, "cold_gens", None) or frozenset()
         pend, gens, nrows = [], [], 0
+        pend_cold = False
         for rb, _row_id, gen in src:  # cursor
             n = rb.num_valid
             if n == 0:
                 continue
+            is_cold = gen in cold_gens
             # The hot remainder (gen None) must not join a sealed feed: sealed
             # feeds are immutable and HBM-cached, the hot tail changes every
             # write — mixing them would force a full re-upload per query.
-            if gen is None and pend:
-                yield emit(pend, gens, nrows)
+            # Cold↔RAM boundaries flush for the dual reason: a cold batch in
+            # a RAM feed would poison the cacheable-feed key (and vice versa
+            # hide RAM rows inside a never-cached cold feed).
+            if pend and (gen is None or is_cold != pend_cold):
+                yield emit(pend, gens, nrows, pend_cold)
                 pend, gens, nrows = [], [], 0
+            pend_cold = is_cold
             pend.append({k: rb.columns[k][:n] for k in names})
             gens.append(gen)
             nrows += n
             self.stats["rows_scanned"] += n
             self.stats["batches"] += 1
             if nrows >= target:
-                yield emit(pend, gens, nrows)
+                yield emit(pend, gens, nrows, pend_cold)
                 pend, gens, nrows = [], [], 0
         if pend:
-            yield emit(pend, gens, nrows)
+            yield emit(pend, gens, nrows, pend_cold)
 
     # ---------------------------------------------------------------- blocking
     def _eval_blocking(self, op) -> HostBatch:

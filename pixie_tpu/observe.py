@@ -184,6 +184,8 @@ STORAGE_STATE_RELATION = Relation.of(
     ("journal_segments", DT.INT64),
     ("repl_lag_batches", DT.INT64),
     ("peer_lag", DT.STRING),
+    ("cold_bytes", DT.INT64, ST.ST_BYTES),
+    ("cold_segments", DT.INT64),
 )
 
 #: adaptive-gate decision stream (engine/autotune.py): every profile-fed

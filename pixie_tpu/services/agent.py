@@ -383,6 +383,24 @@ class Agent:
                 daemon=True,
                 name=f"pixie-agent-telemetry-{self.name}",
             ).start()
+        elif msg == "rehome_prepare":
+            # donor-side shard re-homing prep (broker.rehome_agent) — OFF
+            # the read loop: force-sealing takes table locks and the
+            # replication drain blocks up to its budget
+            threading.Thread(
+                target=self._answer_rehome_prepare,
+                args=(payload.get("req_id"),), daemon=True,
+                name=f"pixie-agent-rehome-{self.name}",
+            ).start()
+        elif msg == "rehome_audit":
+            # target-side coverage audit: report the replica manifest this
+            # node holds FOR the donor so the broker can verify the move
+            threading.Thread(
+                target=self._answer_rehome_audit,
+                args=(payload.get("req_id"), payload.get("donor")),
+                daemon=True,
+                name=f"pixie-agent-rehome-audit-{self.name}",
+            ).start()
         elif msg == "storage_report":
             # on-demand storage observatory read (broker heat_map RPC):
             # current decayed heat + storage state, NOT a fold — nothing is
@@ -436,6 +454,59 @@ class Agent:
             "msg": "retire_info", "req_id": req_id,
             "agent": self.name, "rows": rows, "repl_synced": synced,
             "peer_sync": peer_sync}))
+
+    def _answer_rehome_prepare(self, req_id) -> None:
+        """Donor half of a shard move (broker.rehome_agent): force-seal
+        every hot remainder into replicable sealed form, drain the
+        replication stream (the staged target is already in our shard map,
+        so the seals ship to it), and report per-table row frontiers — the
+        coverage the broker audits against the target's replica manifest."""
+        from pixie_tpu.table.table import Table
+
+        tables: dict = {}
+        err = ""
+        synced = False
+        try:
+            skip = _journal.non_durable_tables()
+            for n in self.store.names():
+                if n.startswith("self_telemetry.") or n in skip:
+                    continue
+                t = self.store._tables.get(n)
+                if not isinstance(t, Table):
+                    continue
+                t.seal_hot()
+                tables[n] = {"first": int(t.first_row_id()),
+                             "last": int(t.last_row_id())}
+            synced = (self.replication is not None
+                      and self.replication.wait_synced(10.0))
+        except Exception as e:
+            err = str(e)
+        self.conn.send(wire.encode_json({
+            "msg": "rehome_info", "req_id": req_id, "agent": self.name,
+            "phase": "prepare", "tables": tables,
+            "repl_synced": bool(synced),
+            "peer_sync": (self.replication.sync_state()
+                          if self.replication is not None else {}),
+            "error": err}))
+
+    def _answer_rehome_audit(self, req_id, donor) -> None:
+        """Target half of a shard move: the replica manifest this node
+        holds FOR the donor ({table: {ranges: [[start, n]...]}}), which the
+        broker diffs against the donor's reported frontiers to decide
+        whether the flip is safe to commit."""
+        man: dict = {}
+        err = ""
+        try:
+            if self.replication is not None:
+                man = self.replication.replicas.manifest(str(donor or ""))
+        except Exception as e:
+            err = str(e)
+        self.conn.send(wire.encode_json({
+            "msg": "rehome_info", "req_id": req_id, "agent": self.name,
+            "phase": "audit", "donor": donor,
+            "tables": {n: {"ranges": m.get("ranges") or []}
+                       for n, m in man.items()},
+            "error": err}))
 
     def _answer_storage_report(self, req_id) -> None:
         """One storage_report RPC answer: this agent's decayed shard-heat

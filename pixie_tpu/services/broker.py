@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json as _json
 import threading
+import time
 import traceback
 from typing import Optional
 
@@ -568,6 +569,9 @@ class Broker:
         trace.register_gauges()
         self.serving.attach_gauges()
         self.ratemodel.attach_gauges()
+        # interrupted shard moves from a prior broker life abort BEFORE the
+        # first shard-map push: ownership stays with the donor
+        self._abort_stale_moves()
         self._server.start()
         self._expiry_thread.start()
         self.cron.start()
@@ -749,9 +753,9 @@ class Broker:
                     "quotas": self.serving.quotas(),
                     "rate_model": self.ratemodel.snapshot(),
                 }))
-            elif msg in ("retire_info", "storage_report"):
+            elif msg in ("retire_info", "storage_report", "rehome_info"):
                 # reply to a broker→agent control RPC (retire drain audit /
-                # heat_map storage fan-out)
+                # heat_map storage fan-out / re-homing prepare+audit)
                 with self._qlock:
                     slot = self._control_replies.get(payload.get("req_id"))
                 if slot is not None:
@@ -763,6 +767,13 @@ class Broker:
                 threading.Thread(
                     target=self._answer_heat_map, args=(conn, payload),
                     daemon=True, name="pixie-broker-heatmap",
+                ).start()
+            elif msg == "rehome_agent":
+                # operator/controller shard move — off the read loop: the
+                # prepare RPC + coverage audit block for seconds
+                threading.Thread(
+                    target=self._answer_rehome, args=(conn, payload),
+                    daemon=True, name="pixie-broker-rehome",
                 ).start()
             elif msg == "deregister_agent":
                 # operator decommission: drop the durable record so the
@@ -1091,6 +1102,191 @@ class Broker:
             "msg": "heat_map", "req_id": payload.get("req_id"),
             "agents": agents, "tables": tables}))
 
+    # ---------------------------------------------------------- shard re-homing
+    def _answer_rehome(self, conn: Connection, payload: dict) -> None:
+        """Control-frame wrapper for rehome_agent (cli / tests)."""
+        res = self.rehome_agent(str(payload.get("agent")),
+                                target=(str(payload["target"])
+                                        if payload.get("target") else None),
+                                reason=str(payload.get("reason") or "manual"))
+        conn.send(wire.encode_json({
+            "msg": "rehome_result", "req_id": payload.get("req_id"), **res}))
+
+    def _pick_rehome_target(self, donor: str) -> Optional[str]:
+        """A live peer to re-home `donor`'s shard onto: prefer one that
+        already replicates the donor (its copy is a backfill head start);
+        otherwise the live agent backing the fewest shards (spread, not
+        pile-up).  None when the donor is the only live agent."""
+        live = sorted(r.name for r in self.registry.live_agents()
+                      if r.name != donor)
+        if not live:
+            return None
+        m = self.registry.shard_map()
+        for r in m.get(donor) or []:
+            if r in live:
+                return r
+        load = {a: 0 for a in live}
+        for _p, reps in m.items():
+            for r in reps or []:
+                if r in load:
+                    load[r] += 1
+        return min(live, key=lambda a: (load[a], a))
+
+    @staticmethod
+    def _manifest_covers(ranges: list, first: int, last: int) -> bool:
+        """True when the sorted [start, n] ranges contiguously cover
+        [first, last) — the donor's sealed frontier.  An empty frontier
+        (first == last) needs no batches."""
+        if first >= last:
+            return True
+        if not ranges or int(ranges[0][0]) > first:
+            return False
+        end = int(ranges[0][0])
+        for start, n in ranges:
+            if int(start) > end:
+                break  # hole
+            end = max(end, int(start) + int(n))
+        return end >= last
+
+    def rehome_agent(self, donor: str, target: Optional[str] = None,
+                     reason: str = "manual") -> dict:
+        """Move the `donor` shard's sealed data onto `target` over the
+        PR 12 replication channel — the heavy half of elastic rebalancing
+        (hot shards migrate instead of refusing to retire).  Two-phase:
+
+          prepare — durable `move/<donor>` KV record, then the target is
+             staged as an extra shard-map replica (registry.add_replica):
+             the donor's ReplicationManager backfills every sealed batch
+             to it over the normal channel — no new transfer code.  A
+             `rehome_prepare` RPC force-seals the donor's hot remainders
+             (table.seal_hot) and drains the stream, so the frontier the
+             donor reports is fully shipped.
+          verify — a `rehome_audit` RPC asks the TARGET what it actually
+             holds for the donor; the broker diffs the replica manifest
+             against the donor's reported per-table frontiers.  Bounded
+             retries (backfill is async); incarnation fences on BOTH ends
+             abort the move if either process restarted mid-flight.
+          commit — the move record is deleted; the staged replica STAYS
+             in the map (durable under rehome/<donor>), so failover and
+             the retire audit find the copy.  The registry epoch bump
+             from staging already invalidated every plan cache.
+
+        Crash-safety: ownership stays with the donor until commit — an
+        interrupted move leaves only an EXTRA copy staged, and a
+        restarted broker aborts the stale `move/` record (start()).
+        Returns {ok, donor, target, tables, synced, reason}."""
+        from pixie_tpu import metrics as _metrics
+
+        def _abort(why: str, staged: bool = False) -> dict:
+            if staged:
+                self.registry.remove_replica(donor, target)
+                self._push_shard_map()
+            self.kv.delete(f"move/{donor}")
+            _metrics.counter_inc(
+                "px_rehome_aborts_total",
+                help_="shard re-homing moves aborted before commit "
+                      "(ownership stayed with the donor)")
+            return {"ok": False, "donor": donor, "target": target,
+                    "tables": {}, "synced": False, "reason": why}
+
+        if not _replication.enabled():
+            return {"ok": False, "donor": donor, "target": target,
+                    "tables": {}, "synced": False,
+                    "reason": "replication disabled (PL_REPLICATION<=1)"}
+        rec = self.registry.record(donor)
+        if rec is None or not rec.alive:
+            return {"ok": False, "donor": donor, "target": target,
+                    "tables": {}, "synced": False,
+                    "reason": "donor not live"}
+        if target is None:
+            target = self._pick_rehome_target(donor)
+        if target is None or target == donor:
+            return {"ok": False, "donor": donor, "target": target,
+                    "tables": {}, "synced": False,
+                    "reason": "no live re-home target"}
+        trec = self.registry.record(target)
+        if trec is None or not trec.alive:
+            return {"ok": False, "donor": donor, "target": target,
+                    "tables": {}, "synced": False,
+                    "reason": "target not live"}
+        # incarnation fences: a donor or target that restarts mid-move
+        # invalidates the coverage evidence gathered so far
+        d_inc = self.registry.incarnation(donor)
+        t_inc = self.registry.incarnation(target)
+        self.kv.set_json(f"move/{donor}", {
+            "target": target, "reason": reason, "phase": "prepare"})
+        self.registry.add_replica(donor, target)
+        self._push_shard_map()
+        try:
+            prep = self._agent_rpc(donor, {"msg": "rehome_prepare"},
+                                   timeout=15.0)
+        except TimeoutError as e:
+            return _abort(f"prepare failed: {e}", staged=True)
+        if prep.get("error"):
+            return _abort(f"prepare failed: {prep['error']}", staged=True)
+        frontiers = {n: (int(f.get("first") or 0), int(f.get("last") or 0))
+                     for n, f in (prep.get("tables") or {}).items()}
+        covered = False
+        for _try in range(20):
+            if (self.registry.incarnation(donor) != d_inc
+                    or self.registry.incarnation(target) != t_inc):
+                return _abort("incarnation changed mid-move", staged=True)
+            try:
+                audit = self._agent_rpc(
+                    target, {"msg": "rehome_audit", "donor": donor},
+                    timeout=5.0)
+            except TimeoutError as e:
+                return _abort(f"audit failed: {e}", staged=True)
+            man = audit.get("tables") or {}
+            covered = all(
+                self._manifest_covers(
+                    (man.get(n) or {}).get("ranges") or [], first, last)
+                for n, (first, last) in frontiers.items())
+            if covered:
+                break
+            time.sleep(0.25)
+        if not covered:
+            return _abort("target manifest never covered the donor "
+                          "frontier", staged=True)
+        # commit: the one-key delete IS the flip — a crash before it
+        # replays as an abort (extra copy unstaged, donor keeps owning)
+        self.kv.delete(f"move/{donor}")
+        _metrics.counter_inc(
+            "px_rehome_moves_total",
+            help_="shard re-homing moves committed (donor sealed data "
+                  "verified resident on the target)")
+        _metrics.counter_inc(
+            "px_rehome_moved_tables_total", float(len(frontiers)),
+            help_="tables whose sealed frontier was re-homed")
+        self.record_scale_event(
+            "rehome", donor, f"{reason} -> {target}", 0.0,
+            len(self.registry.live_agents()))
+        return {"ok": True, "donor": donor, "target": target,
+                "tables": {n: {"first": f, "last": l}
+                           for n, (f, l) in frontiers.items()},
+                "synced": bool(prep.get("repl_synced")), "reason": ""}
+
+    def _abort_stale_moves(self) -> None:
+        """Broker restart mid-move: every surviving `move/` record is a
+        prepare that never committed — unstage its extra replica and
+        delete it.  Ownership stays with the donor (the two-phase flip's
+        crash guarantee); the staged copy was only ever additive."""
+        from pixie_tpu import metrics as _metrics
+
+        for key, raw in list(self.kv.scan("move/")):
+            donor = key.split("/", 1)[1]
+            try:
+                d = _json.loads(raw.decode())
+            except Exception:
+                d = {}
+            if d.get("target"):
+                self.registry.remove_replica(donor, str(d["target"]))
+            self.kv.delete(key)
+            _metrics.counter_inc(
+                "px_rehome_stale_aborts_total",
+                help_="interrupted re-homing moves aborted at broker "
+                      "startup (ownership left with the donor)")
+
     def retire_agent(self, name: str, force: bool = False) -> dict:
         """Scale-down decommission with loss safety (the autoscaler's
         retire path; serving/elastic.py).  Protocol:
@@ -1122,14 +1318,25 @@ class Broker:
                     "reason": "unknown agent", "peer_sync": {}}
         sole = self._sole_holder_of(name)
         if sole and not force:
-            _metrics.counter_inc(
-                "px_autoscale_retire_refused_total",
-                help_="scale-down retires refused by the loss-safety audit "
-                      "(last live shard holder, unauditable rows, or "
-                      "unsynced replication)")
-            return {"ok": False, "mode": None, "rows": None,
-                    "reason": f"last live holder of shard(s) {sole}",
-                    "peer_sync": {}}
+            # rehome-first: instead of refusing outright, try moving the
+            # sole-held shard onto a live peer over the replication
+            # channel, then re-check.  A failed move (no peers, audit
+            # never covered, replication off) falls back to the old
+            # refusal — force keeps the old semantics entirely.
+            moved = self.rehome_agent(name, reason="retire")
+            if moved.get("ok"):
+                sole = self._sole_holder_of(name)
+            if sole:
+                _metrics.counter_inc(
+                    "px_autoscale_retire_refused_total",
+                    help_="scale-down retires refused by the loss-safety "
+                          "audit (last live shard holder, unauditable "
+                          "rows, or unsynced replication)")
+                return {"ok": False, "mode": None, "rows": None,
+                        "reason": f"last live holder of shard(s) {sole}"
+                                  + (f"; rehome failed: {moved['reason']}"
+                                     if moved.get("reason") else ""),
+                        "peer_sync": {}}
         rows = None
         repl_synced = False
         peer_sync: dict = {}
@@ -1155,6 +1362,17 @@ class Broker:
         if rows > 0 and not force:
             reps = self.registry.shard_map().get(name) or []
             live = {r.name for r in self.registry.live_agents()}
+            if not (_replication.enabled() and repl_synced
+                    and any(r in live for r in reps)):
+                # rehome-first here too: a failed drain audit (unsynced
+                # stream, no live replica yet) is exactly what the move
+                # protocol repairs — it force-seals, drains, and VERIFIES
+                # the target's coverage before the hand-off proceeds
+                moved = self.rehome_agent(name, reason="retire")
+                if moved.get("ok"):
+                    repl_synced = True
+                    reps = self.registry.shard_map().get(name) or []
+                    live = {r.name for r in self.registry.live_agents()}
             if not (_replication.enabled() and repl_synced
                     and any(r in live for r in reps)):
                 _metrics.counter_inc(

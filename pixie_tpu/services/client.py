@@ -132,7 +132,7 @@ class Client:
         elif msg == "schemas":
             p.schemas = meta["schemas"]
             p.done.set()
-        elif msg in ("quota_ok", "quotas", "heat_map"):
+        elif msg in ("quota_ok", "quotas", "heat_map", "rehome_result"):
             p.reply = meta
             p.done.set()
         elif msg == "error":
@@ -296,6 +296,16 @@ class Client:
         reply = self._control_rpc({"msg": "heat_map"})
         return {"agents": reply.get("agents") or {},
                 "tables": reply.get("tables") or {}}
+
+    def rehome(self, agent: str, target: Optional[str] = None,
+               reason: str = "manual") -> dict:
+        """Operator shard re-homing: move `agent`'s sealed shard data onto
+        `target` (broker picks one when None) over the replication channel
+        and flip the shard map — the drain half of a decommission.
+        Returns the broker's {ok, donor, target, tables, reason} verdict;
+        a not-ok reply means ownership stayed with the donor."""
+        return self._control_rpc({"msg": "rehome_agent", "agent": agent,
+                                  "target": target, "reason": reason})
 
     def _control_rpc(self, meta: dict) -> dict:
         rid, p = self._new_pending()

@@ -1,0 +1,244 @@
+"""RebalanceController: skew-driven shard re-homing (data lifecycle).
+
+The storage observatory (table/heat.py, PR 17) measures per-shard decayed
+heat; this module ACTS on it.  One tick per ``PL_REBALANCE_S``:
+
+  * fan out ``storage_report`` RPCs to every live agent (the same probe
+    ``Broker._answer_heat_map`` aggregates) and fold per-shard heat;
+  * skew = hottest / mean over LIVE shards (cold empty capacity counts
+    as zero heat — that is exactly the imbalance worth fixing);
+  * skew past ``PL_REBALANCE_SKEW`` with the cooldown lapsed → move the
+    hottest shard: ``Broker.rehome_agent(donor, coldest)`` ships the
+    donor's sealed data to the coldest peer over the replication channel
+    (two-phase, crash-safe — ownership stays with the donor until the
+    target's replica manifest verifiably covers the donor's frontier),
+    then ``Broker.retire_agent(donor)`` hands the shard off so failover
+    serves it from the moved copy, and the optional ``stop_agent``
+    callable stops the donor process.
+
+Every decision lands in ``self_telemetry.scale_events`` through the
+broker's normal path (``rehome`` rows from the move itself, ``rebalance``
+rows from this loop).  ``PL_REBALANCE_S=0`` (the default) never starts
+the loop — the data plane is bit-identical to the fixed-placement engine.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from pixie_tpu import flags, metrics
+
+flags.define_float(
+    "PL_REBALANCE_S", 0.0,
+    "shard re-homing control loop tick period (services/rebalance.py): "
+    "measure per-shard heat skew and move the hottest shard onto the "
+    "coldest live peer when it exceeds PL_REBALANCE_SKEW; 0 disables "
+    "(fixed placement, the seed behavior)")
+flags.define_float(
+    "PL_REBALANCE_SKEW", 1.3,
+    "hottest/mean shard-heat ratio at or above which one re-homing move "
+    "triggers per cooldown")
+flags.define_float(
+    "PL_REBALANCE_COOLDOWN_S", 5.0,
+    "minimum seconds between re-homing moves — a move changes the heat "
+    "surface it was decided on, so the next decision waits for fresh "
+    "measurements")
+flags.define_float(
+    "PL_REBALANCE_MIN_HEAT", 1000.0,
+    "decayed-heat floor (rows) the hottest shard must exceed before any "
+    "move: skew ratios over a near-idle fleet are noise, not imbalance")
+
+#: pxlint lock-discipline: controller counters are owned by its one mutex
+_pxlint_locks_ = {
+    "_note_move_locked": "self._lock",
+}
+
+
+class RebalanceController:
+    """The broker's shard-placement control loop (see module docstring).
+
+    Constructed by harnesses/benches with the broker and an optional
+    ``stop_agent(name)`` callable that stops the donor process after a
+    successful hand-off (a ThreadLauncher/ProcLauncher stop, or a k8s
+    pod delete in a real deployment)."""
+
+    def __init__(self, broker, stop_agent: Optional[Callable] = None,
+                 min_agents: int = 2):
+        self.broker = broker
+        self.stop_agent = stop_agent
+        self.min_agents = max(int(min_agents), 2)
+        self._lock = threading.Lock()
+        self._last_move = 0.0
+        self.moves = 0
+        self.skips = 0
+        self.last_skew = 1.0
+        self.last_outlier = 1.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._gauges = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "RebalanceController":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        if not self._gauges:
+            self._gauges = True
+            metrics.register_gauge_fn(
+                "px_rebalance_skew",
+                lambda: {(): float(self.last_skew)},
+                "hottest/mean shard-heat ratio at the last rebalance tick")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pixie-rebalance")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=5.0)
+        if self._gauges:
+            self._gauges = False
+            metrics.unregister_gauge_fn("px_rebalance_skew")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(
+                timeout=max(float(flags.get("PL_REBALANCE_S")), 0.05)):
+            try:
+                self.tick()
+            except Exception:
+                metrics.counter_inc(
+                    "px_rebalance_tick_errors_total",
+                    help_="rebalance ticks that raised (the loop survives; "
+                          "the decision is skipped)")
+
+    # ------------------------------------------------------------- decision
+    def shard_heat(self) -> dict[str, float]:
+        """{live agent → summed decayed shard heat}.  Agents whose probe
+        fails (or that report nothing) count as zero — missing evidence
+        must read as cold, never as hot enough to move.  Heat a live
+        agent accrues serving a DEAD primary's shard through takeover
+        rides under that primary's shard name (replication.takeover_store)
+        and is deliberately invisible here: it belongs to the moved shard,
+        not the host's own, and folding it in would make every move target
+        read hottest (takeover serving full-scans — no matviews) and
+        cascade the fleet."""
+        heat: dict[str, float] = {
+            r.name: 0.0 for r in self.broker.registry.live_agents()}
+        # per-(shard, table) fold takes the MAX across reports: in-process
+        # harnesses share one heat registry, so every agent's report sees
+        # every shard's rows — summing them would multiply heat by fleet
+        # size and trip the skew gate on a perfectly balanced cluster
+        seen: dict[tuple, float] = {}
+        for name in list(heat):
+            try:
+                rep = self.broker._agent_rpc(
+                    name, {"msg": "storage_report"}, timeout=5.0)
+            except Exception:
+                continue
+            for r in rep.get("shard_heat") or []:
+                key = (str(r.get("shard")), str(r.get("table_name")))
+                seen[key] = max(seen.get(key, 0.0),
+                                float(r.get("heat") or 0.0))
+        for (shard, _table), h in seen.items():
+            if shard in heat:
+                heat[shard] += h
+        return heat
+
+    @staticmethod
+    def skew_of(heat: dict[str, float]) -> float:
+        """max/mean — the observatory's standard skew statistic."""
+        vals = list(heat.values())
+        mean = sum(vals) / max(len(vals), 1)
+        return (max(vals) / mean) if mean > 0 else 1.0
+
+    @staticmethod
+    def outlier_of(heat: dict[str, float]) -> float:
+        """max/median — the MOVE gate.  max/mean alone would cascade: the
+        moment a move lands, the target has served for zero half-lives and
+        reads cold, dragging the mean down and re-arming the trigger until
+        the fleet consolidates onto one node.  Against the median, a
+        cluster whose only imbalance is an idle spare (or a just-moved-to
+        node still warming) reads 1.0 — only a genuinely hot outlier
+        shard justifies a move."""
+        vals = sorted(heat.values())
+        if not vals:
+            return 1.0
+        n = len(vals)
+        med = (vals[n // 2] if n % 2
+               else (vals[n // 2 - 1] + vals[n // 2]) / 2.0)
+        return (vals[-1] / med) if med > 0 else 1.0
+
+    def _note_move_locked(self, now: float) -> None:
+        self._last_move = now
+        self.moves += 1
+
+    def tick(self, now: Optional[float] = None) -> Optional[dict]:
+        """One placement decision (public so tests and benches drive it
+        deterministically).  Returns the move result dict when a move was
+        attempted, None otherwise."""
+        now = time.monotonic() if now is None else now
+        heat = self.shard_heat()
+        self.last_skew = self.skew_of(heat)
+        self.last_outlier = self.outlier_of(heat)
+        if len(heat) < self.min_agents:
+            return None
+        threshold = float(flags.get("PL_REBALANCE_SKEW"))
+        cooldown = float(flags.get("PL_REBALANCE_COOLDOWN_S"))
+        with self._lock:
+            cooling = now - self._last_move < cooldown
+        # BOTH gates must trip: mean-skew says the fleet is imbalanced,
+        # median-outlier says the hottest shard (not an idle spare or a
+        # still-warming move target) is what's causing it — and the
+        # hottest shard must carry real heat, not decayed noise
+        if self.last_skew < threshold or self.last_outlier < threshold \
+                or cooling \
+                or max(heat.values()) < float(
+                    flags.get("PL_REBALANCE_MIN_HEAT")):
+            return None
+        donor = max(heat, key=lambda a: (heat[a], a))
+        target = min((a for a in heat if a != donor),
+                     key=lambda a: (heat[a], a))
+        reason = f"skew {self.last_skew:.2f} >= {threshold:.2f}"
+        moved = self.broker.rehome_agent(donor, target=target,
+                                         reason=reason)
+        if not moved.get("ok"):
+            self.skips += 1
+            metrics.counter_inc(
+                "px_rebalance_move_refused_total",
+                help_="skew-triggered re-homing moves that the two-phase "
+                      "protocol refused or aborted")
+            return moved
+        with self._lock:
+            self._note_move_locked(now)
+        metrics.counter_inc(
+            "px_rebalance_moves_total",
+            help_="skew-triggered shard moves committed by the rebalance "
+                  "control loop")
+        # hand off serving: the donor's shard now answers through failover
+        # from the moved copy; a refused retire (e.g. audit timeout) leaves
+        # the donor serving with an extra replica staged — safe, retried
+        # next tick once the cooldown lapses
+        retired = self.broker.retire_agent(donor)
+        if retired.get("ok") and self.stop_agent is not None:
+            try:
+                self.stop_agent(donor)
+            except Exception:
+                metrics.counter_inc(
+                    "px_rebalance_stop_errors_total",
+                    help_="donor stop callbacks that raised after a "
+                          "successful hand-off")
+        self.broker.record_scale_event(
+            "rebalance", donor, reason, self.last_skew,
+            len(self.broker.registry.live_agents()))
+        return {**moved, "retired": retired}
+
+
+def maybe_start(broker, stop_agent: Optional[Callable] = None):
+    """Arm the controller when PL_REBALANCE_S > 0 (cli/bench hook);
+    returns the started controller or None."""
+    if float(flags.get("PL_REBALANCE_S")) <= 0:
+        return None
+    return RebalanceController(broker, stop_agent=stop_agent).start()

@@ -1,0 +1,334 @@
+"""Elastic-rebalance harness (the `elastic_rebalance` bench config).
+
+The data-lifecycle proof (ROADMAP item 2): a real broker + agent cluster
+with an UNEVEN data plane — three seed agents with equal base shards, one
+of them also carrying a hot extra table, plus one empty spare — under a
+3-cycle diurnal client curve, with the RebalanceController and the
+compressed cold tier live.  Must hold, all measured from the run, all
+guarded absolutely by ``bench.py --check-regressions``:
+
+  * **the hot shard moves** — per-shard heat skew crosses
+    ``PL_REBALANCE_SKEW`` during the first high phase; the controller
+    re-homes the hottest agent onto the cold spare over the replication
+    channel (two-phase, coverage-verified) and retires it (`moves` >= 1),
+    after which the skew settles at or under the threshold (`skew_final`).
+  * **zero loss, bit-equal throughout** — every query answered during the
+    move is bit-equal to its fixed-placement baseline (`bit_equal_frac`),
+    and the total row count after the ramp equals the count before it
+    (`row_loss` == 0).
+  * **the cold tier holds its ceiling** — `PL_COLD_MAX_HOT_MB` demotes
+    sealed batches to compressed disk segments (`demotions` >= 1) and the
+    in-RAM sealed footprint of any cold-managed table stays bounded
+    (`hot_ram_peak_mb`), while those cold batches keep serving scans.
+
+The spare joins schema-matched and EMPTY, so placement is the only thing
+the move changes — not one result bit.
+"""
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from pixie_tpu.services.chaos_bench import _mkdata, canonical_bytes
+
+#: flags the harness overrides and restores
+_FLAGS = (
+    "PL_DATA_DIR", "PL_REPLICATION", "PL_QUERY_RETRIES", "PL_CLIENT_RETRIES",
+    "PL_RETRY_BACKOFF_MS", "PL_REJOIN_GRACE_S", "PL_JOURNAL_FSYNC",
+    "PL_COLD_TIER", "PL_COLD_AFTER_S", "PL_COLD_MAX_HOT_MB",
+    "PL_COLD_PROMOTE_READS", "PL_HEAT_HALF_LIFE_S",
+    "PL_REBALANCE_S", "PL_REBALANCE_SKEW", "PL_REBALANCE_COOLDOWN_S",
+)
+
+#: base-shard agg + hot-table agg + count probe: the mix every client
+#: rotates through (the count probe doubles as the row-loss audit)
+SCRIPTS = [
+    """
+df = px.DataFrame(table='http_events')
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mx=('latency', px.max))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='hot_events')
+df = df.groupby('service').agg(cnt=('latency', px.count),
+                               mn=('latency', px.min))
+px.display(df, 'out')
+""",
+    """
+df = px.DataFrame(table='http_events')
+df = df.agg(cnt=('status', px.count))
+px.display(df, 'out')
+""",
+]
+
+
+def _mkstore(seed: int, rows: int, hot_rows: int = 0,
+             batch_rows: int = 2048):
+    """Base shard (+ optional hot_events extra table).  `hot_events` exists
+    on the overloaded seed and (empty) on the spare, so the hot table's
+    scans concentrate on one agent — the skew the controller must fix."""
+    from pixie_tpu.table import TableStore
+    from pixie_tpu.types import DataType as DT, Relation
+
+    rel = Relation.of(
+        ("time_", DT.TIME64NS), ("service", DT.STRING),
+        ("latency", DT.FLOAT64), ("status", DT.INT64),
+    )
+    ts = TableStore()
+    ts.create("http_events", rel, batch_rows=batch_rows, max_bytes=1 << 32)
+    if hot_rows or not rows:
+        # the overloaded seed AND the empty spare carry the hot schema
+        ts.create("hot_events", rel, batch_rows=batch_rows,
+                  max_bytes=1 << 32)
+    return ts
+
+
+def _count_rows(client, tables=("http_events", "hot_events")) -> int:
+    from pixie_tpu.services.client import QueryError
+
+    total = 0
+    for t in tables:
+        try:
+            res = client.execute_script(
+                f"df = px.DataFrame(table='{t}')\n"
+                f"df = df.agg(cnt=('status', px.count))\n"
+                f"px.display(df, 'rows')\n")
+        except QueryError as e:
+            if "not found" in str(e):
+                # no live holder: every row of this table is lost from the
+                # serving plane — count 0 so the loss lands in `row_loss`
+                continue
+            raise
+        rec = next(iter(res.values()))
+        total += int(np.sum(rec.columns["cnt"]))
+    return total
+
+
+def run_elastic_rebalance(clients_high: int = 12, clients_low: int = 3,
+                          cycles: int = 3, phase_s: tuple = (1.5, 3.0),
+                          rows: int = 60_000, settle_s: float = 2.5,
+                          data_dir: str = None) -> dict:
+    """Drive the 3-cycle diurnal ramp over the uneven cluster; returns the
+    elastic_rebalance result dict."""
+    import pixie_tpu.services.replication  # noqa: F401 — PL_REPLICATION
+    import pixie_tpu.table.lifecycle  # noqa: F401 — PL_COLD_* flags
+    import pixie_tpu.table.heat  # noqa: F401 — PL_HEAT_HALF_LIFE_S
+
+    from pixie_tpu import flags, metrics
+    from pixie_tpu.services.agent import Agent
+    from pixie_tpu.services.broker import Broker
+    from pixie_tpu.services.client import Client, QueryError
+    from pixie_tpu.services.rebalance import RebalanceController
+    from pixie_tpu.table.table import Table
+
+    saved = {n: flags.get(n) for n in _FLAGS}
+    tmp = data_dir or tempfile.mkdtemp(prefix="px-rebalance-")
+    flags.set_for_testing("PL_DATA_DIR", tmp)
+    flags.set_for_testing("PL_REPLICATION", 2)
+    flags.set_for_testing("PL_QUERY_RETRIES", 6)
+    flags.set_for_testing("PL_CLIENT_RETRIES", 6)
+    flags.set_for_testing("PL_RETRY_BACKOFF_MS", 80)
+    flags.set_for_testing("PL_REJOIN_GRACE_S", 0.3)
+    flags.set_for_testing("PL_JOURNAL_FSYNC", "batch")
+    # cold tier live with a deliberately TIGHT RAM ceiling: the base shards
+    # (~1.6 MB sealed each at the default row count) must demote their tails
+    # to compressed disk and keep serving the ramp's scans decode-on-read
+    flags.set_for_testing("PL_COLD_TIER", 1)
+    flags.set_for_testing("PL_COLD_AFTER_S", 0.0)  # ceiling-driven only
+    flags.set_for_testing("PL_COLD_MAX_HOT_MB", 1)
+    flags.set_for_testing("PL_COLD_PROMOTE_READS", 0)  # hold the ceiling
+    # short heat half-life: the final skew reading reflects the settled
+    # post-move placement, not the pre-move history
+    flags.set_for_testing("PL_HEAT_HALF_LIFE_S", 4.0)
+    flags.set_for_testing("PL_REBALANCE_S", 0.3)
+    flags.set_for_testing("PL_REBALANCE_SKEW", 1.3)
+    flags.set_for_testing("PL_REBALANCE_COOLDOWN_S", 5.0)
+
+    n_seed = 3
+    # the script mix is 2 http scans : 1 hot scan, so the donor's heat is
+    # (2·rows + hot_rows) against 2·rows on its peers — 0.8 makes the donor
+    # a 1.4× median outlier (trips the 1.3 gate with margin) and the
+    # settled post-move fleet a 1.24 mean-skew (back under the gate)
+    hot_rows = int(rows * 0.8)
+    broker = Broker(hb_expiry_s=5.0, query_timeout_s=60.0).start()
+    agents = {}
+    for i in range(n_seed):
+        agents[f"pem{i}"] = Agent(
+            f"pem{i}", "127.0.0.1", broker.port,
+            store=_mkstore(i + 1, rows, hot_rows=(hot_rows if i == 0 else 0)),
+            heartbeat_s=0.5).start()
+    agents["spare0"] = Agent("spare0", "127.0.0.1", broker.port,
+                             store=_mkstore(0, 0), heartbeat_s=0.5).start()
+    # demotion baseline BEFORE ingest: the ceiling-driven retention pass
+    # demotes the sealed tail during the writes below, not during the ramp
+    demote0 = metrics.counter_value("px_cold_demotions_total")
+    # ingest AFTER start so the journal + cold tier are attached: the tight
+    # RAM ceiling demotes the sealed tail as it lands
+    for i in range(n_seed):
+        st = agents[f"pem{i}"].store
+        st.table("http_events").write(_mkdata(i + 1, rows))
+        if i == 0:
+            st.table("hot_events").write(_mkdata(17, hot_rows))
+    deadline = time.monotonic() + 20.0
+    for a in agents.values():
+        assert a.replication.wait_synced(max(deadline - time.monotonic(),
+                                             0.1))
+    controller = RebalanceController(
+        broker, stop_agent=lambda n: agents[n].stop())
+    client = Client("127.0.0.1", broker.port, timeout_s=90.0)
+    pool = [Client("127.0.0.1", broker.port, timeout_s=90.0)
+            for _ in range(4)]
+
+    stop = threading.Event()
+    target = [clients_low]
+    ok = [0]
+    mismatches = [0]
+    errors = [0]
+    lat: list[float] = []
+    count_lock = threading.Lock()
+    ram_peak = [0.0]
+    # (outlier, mean-skew): outlier = max/median shard heat is the guarded
+    # statistic — after the hand-off the move target serves the donor's
+    # shard via takeover (heat rides under the donor's shard name), so its
+    # OWN shard reads cold and mean-skew stays high on an honest,
+    # well-balanced fleet; the outlier reads 1.0 exactly when no live
+    # shard is abnormally hot, which is the property the move must restore
+    skew_live = [1.0, 1.0]
+
+    def sample() -> None:
+        """Peak in-RAM sealed footprint across cold-managed tables, and
+        the live skew reading (taken while traffic still runs)."""
+        peak = 0.0
+        for a in agents.values():
+            store = getattr(a, "store", None)
+            if store is None or a.pod_killed.is_set():
+                continue
+            for n in list(store.names()):
+                t = store._tables.get(n)
+                if isinstance(t, Table) and t.cold is not None:
+                    peak = max(peak, t._sealed_bytes / (1 << 20))
+        ram_peak[0] = max(ram_peak[0], peak)
+
+    try:
+        baseline = [canonical_bytes(client.execute_script(s))
+                    for s in SCRIPTS]
+        rows_before = _count_rows(client)
+
+        def client_loop(idx: int):
+            conn = pool[idx % len(pool)]
+            it = 0
+            while not stop.is_set():
+                if idx >= target[0]:
+                    stop.wait(0.05)
+                    continue
+                si = (idx + it) % len(SCRIPTS)
+                it += 1
+                t0 = time.perf_counter()
+                try:
+                    got = conn.execute_script(SCRIPTS[si])
+                    dt = time.perf_counter() - t0
+                    with count_lock:
+                        ok[0] += 1
+                        lat.append(dt)
+                        if canonical_bytes(got) != baseline[si]:
+                            mismatches[0] += 1
+                except QueryError as e:
+                    if e.retry_after_s is not None:
+                        stop.wait(min(e.retry_after_s, 1.0))
+                    else:
+                        with count_lock:
+                            errors[0] += 1
+                except Exception:
+                    with count_lock:
+                        errors[0] += 1
+
+        threads = [threading.Thread(target=client_loop, args=(i,),
+                                    daemon=True)
+                   for i in range(clients_high)]
+        for th in threads:
+            th.start()
+        controller.start()
+        t_start = time.monotonic()
+        # ---- the diurnal curve: cycles × (low → high), then settle ------
+        phases = []
+        for _c in range(cycles):
+            phases.append((phase_s[0], clients_low))
+            phases.append((phase_s[1], clients_high))
+        phases.append((settle_s, clients_low))
+        for dur, n in phases:
+            target[0] = n
+            end = time.monotonic() + dur
+            while time.monotonic() < end:
+                time.sleep(0.2)
+                sample()
+                skew_live[0] = controller.last_outlier
+                skew_live[1] = controller.last_skew
+        measured_s = time.monotonic() - t_start
+        skew_final, skew_mean_final = skew_live
+        stop.set()
+        for th in threads:
+            th.join(timeout=30.0)
+        rows_after = _count_rows(client)
+        demotions = (metrics.counter_value("px_cold_demotions_total")
+                     - demote0)
+        live_final = sorted(r.name for r in broker.registry.live_agents())
+    finally:
+        controller.stop()
+        for c in pool:
+            c.close()
+        client.close()
+        for a in agents.values():
+            try:
+                a.stop()
+            except Exception:
+                pass
+        broker.stop()
+        for name, v in saved.items():
+            flags.set_for_testing(name, v)
+
+    lat.sort()
+    p99 = lat[int(0.99 * (len(lat) - 1))] if lat else 0.0
+    return {
+        # `rows` = high-phase client count: the --check-regressions shape
+        # key, so a --smoke run never diffs against a full run
+        "rows": clients_high,
+        "clients_high": clients_high,
+        "clients_low": clients_low,
+        "cycles": cycles,
+        "duration_s": round(measured_s, 2),
+        "queries": ok[0],
+        "goodput_qps": round(ok[0] / max(measured_s, 1e-9), 1),
+        "p99_ms": round(p99 * 1000, 1),
+        "client_errors": errors[0],
+        "bit_equal_frac": round((ok[0] - mismatches[0]) / max(ok[0], 1), 4),
+        "moves": controller.moves,
+        "move_refusals": controller.skips,
+        "skew_final": round(skew_final, 3),
+        "skew_mean_final": round(skew_mean_final, 3),
+        "row_loss": int(rows_before - rows_after),
+        "rows_total": rows_before,
+        "demotions": int(demotions),
+        "hot_ram_peak_mb": round(ram_peak[0], 3),
+        "agents_final": live_final,
+    }
+
+
+def main(argv=None):  # pragma: no cover — exercised via bench.py
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients-high", type=int, default=12)
+    ap.add_argument("--rows", type=int, default=60_000)
+    args = ap.parse_args(argv)
+    print(json.dumps(run_elastic_rebalance(clients_high=args.clients_high,
+                                           rows=args.rows),
+                     separators=(",", ":")))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -57,6 +57,20 @@ class AgentRegistry:
         #: compiled queries and distributed splits on this, so a changed
         #: cluster view can never serve a stale plan.
         self.epoch = 0
+        #: re-homing overrides (broker rehome staging): primary → extra
+        #: replica names merged into every recomputed shard map.  Persisted
+        #: per primary under rehome/<name> so a broker restart mid-move
+        #: keeps the staged target receiving the donor's batches — the
+        #: two-phase flip's durable half.
+        self._extra_replicas: dict[str, list] = {}
+        for key, raw in self.kv.scan("rehome/"):
+            import json
+
+            try:
+                self._extra_replicas[key.split("/", 1)[1]] = list(
+                    json.loads(raw.decode()))
+            except Exception:
+                continue
         # Recall durable records (dead until they heartbeat again).
         for key, raw in self.kv.scan("agent/"):
             import json
@@ -163,22 +177,65 @@ class AgentRegistry:
         if k <= 1:
             return
         live = sorted(r.name for r in self._agents.values() if r.alive)
+        live_set = set(live)
         out: dict[str, list] = {}
         import bisect
 
         for name in sorted(self._agents):
             ring = [a for a in live if a != name]
-            if not ring:
-                out[name] = []
-                continue
-            pos = bisect.bisect_left(ring, name)
-            out[name] = [ring[(pos + i) % len(ring)]
-                         for i in range(min(k - 1, len(ring)))]
+            reps: list = []
+            if ring:
+                pos = bisect.bisect_left(ring, name)
+                reps = [ring[(pos + i) % len(ring)]
+                        for i in range(min(k - 1, len(ring)))]
+            # re-homing overrides ride ON TOP of the ring choice: the staged
+            # target replicates the donor's shard regardless of ring position,
+            # so the existing backfill machinery ships the data.  Prepended
+            # (not appended) because failover serves from the FIRST live
+            # replica: once the donor retires, the shard's queries must land
+            # on the move target — landing on a ring peer instead would pile
+            # the moved load onto an already-loaded node and re-trip the
+            # rebalance trigger
+            extras = [e for e in self._extra_replicas.get(name, ())
+                      if e != name and e in live_set]
+            out[name] = extras + [r for r in reps if r not in extras]
         self.kv.set_json("shardmap/current", {"k": k, "map": out})
 
     def shard_map(self) -> dict:
         """The persisted primary→replicas map ({} when replication is off)."""
         return (self.kv.get_json("shardmap/current") or {}).get("map", {})
+
+    def add_replica(self, primary: str, replica: str) -> None:
+        """Stage `replica` as an extra shard-map replica of `primary`
+        (re-homing: the target starts receiving the donor's batches over
+        the normal replication channel).  Durable across broker restarts;
+        undone by remove_replica."""
+        with self._lock:
+            cur = self._extra_replicas.setdefault(primary, [])
+            if replica not in cur:
+                cur.append(replica)
+            self.kv.set_json(f"rehome/{primary}", cur)
+            self.epoch += 1
+            self._update_shard_map_locked()
+
+    def remove_replica(self, primary: str, replica: str) -> None:
+        """Unstage a re-homing replica (move aborted or superseded)."""
+        with self._lock:
+            cur = self._extra_replicas.get(primary)
+            if not cur or replica not in cur:
+                return
+            cur.remove(replica)
+            if cur:
+                self.kv.set_json(f"rehome/{primary}", cur)
+            else:
+                self._extra_replicas.pop(primary, None)
+                self.kv.delete(f"rehome/{primary}")
+            self.epoch += 1
+            self._update_shard_map_locked()
+
+    def extra_replicas(self, primary: str) -> list:
+        with self._lock:
+            return list(self._extra_replicas.get(primary, ()))
 
     def peer_addrs(self) -> dict[str, list]:
         """Replication peer addresses of LIVE agents (dead peers are not
@@ -204,6 +261,8 @@ class AgentRegistry:
                 return False
             self.epoch += 1
             self.kv.delete(f"agent/{name}")
+            if self._extra_replicas.pop(name, None) is not None:
+                self.kv.delete(f"rehome/{name}")
             self._update_shard_map_locked()
             return True
 

@@ -102,7 +102,11 @@ class ReplicaStore:
     """Sealed batches held FOR other primaries, keyed (primary, table,
     row_id_start); materializes takeover TableStores on demand."""
 
-    def __init__(self):
+    def __init__(self, node_name: str = ""):
+        #: the REPLICA's own name (kept for diagnostics; takeover
+        #: materializations are attributed to the PRIMARY's shard name —
+        #: see takeover_store)
+        self.node_name = node_name
         self._lock = threading.Lock()
         #: primary -> table -> {"relation","batch_rows","max_bytes",
         #:                      "batches": {row_id_start: (n, {col: vals})}}
@@ -208,6 +212,15 @@ class ReplicaStore:
                 for name, t in self._data.get(primary, {}).items()
             }
         store = TableStore()
+        # heat attribution: takeover scans account under the PRIMARY's
+        # shard name, not the serving node — shard heat follows the shard
+        # across failover and re-homing (the observatory keeps one stable
+        # identity per shard), and the rebalance controller, which folds
+        # heat per LIVE agent's own shard, never mistakes the full-scan
+        # cost of takeover serving (no matviews on a takeover store) for
+        # the host's own shard running hot — that misread is a move
+        # cascade: every move target immediately looks hottest
+        store.node_name = str(primary)
         # the engine-owned self-telemetry tables (spans, query profiles,
         # op stats, metrics, alerts) exist on every agent by construction,
         # so the dead primary's registered schema advertises them; their
@@ -294,7 +307,7 @@ class ReplicationManager:
     def __init__(self, name: str, store):
         self.name = name
         self.store = store
-        self.replicas = ReplicaStore()
+        self.replicas = ReplicaStore(name)
         self._server = Server("127.0.0.1", 0, self._on_peer_frame)
         self._q: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()

@@ -269,7 +269,10 @@ class FeedRecorder:
 def _sealed_snapshot(table, now_ns: int) -> tuple[int, int, int, dict]:
     """(hot_rows, sealed_batches, sealed_bytes, age_histogram) from one
     table, under its seal lock (the fold runs on the metrics cron, not the
-    query hot path)."""
+    query hot path).  Cold-tier entries (table.lifecycle._ColdBatch stubs
+    whose data lives on disk) count in the batch total and age histogram
+    but NOT in sealed_bytes — that column is host RAM; the disk side is
+    reported as cold_bytes/cold_segments from the tier's own accounting."""
     with table._lock:
         sealed = list(table._sealed)
         hot_rows = int(table._hot_rows)
@@ -277,7 +280,8 @@ def _sealed_snapshot(table, now_ns: int) -> tuple[int, int, int, dict]:
     nbytes = 0
     hist: dict[str, int] = {}
     for b in sealed:
-        nbytes += int(b.nbytes)
+        if not getattr(b, "is_cold", False) or b.in_ram:
+            nbytes += int(b.nbytes)
         age_s = None
         if has_time and b.max_time is not None:
             age_s = max((now_ns - int(b.max_time)) / 1e9, 0.0)
@@ -331,6 +335,10 @@ def storage_state_rows(store, agent: str, now_ns: Optional[int] = None,
         j = getattr(t, "journal", None)
         if j is not None:
             jbytes, jsegs = j.disk_usage()
+        cbytes = csegs = 0
+        tier = getattr(t, "cold", None)
+        if tier is not None:
+            cbytes, csegs = tier.disk_usage()
         rows.append({
             "time_": now_ns,
             "agent": str(agent),
@@ -345,6 +353,8 @@ def storage_state_rows(store, agent: str, now_ns: Optional[int] = None,
             "journal_segments": int(jsegs),
             "repl_lag_batches": int(max_lag),
             "peer_lag": peer_lag,
+            "cold_bytes": int(cbytes),
+            "cold_segments": int(csegs),
         })
     return rows
 

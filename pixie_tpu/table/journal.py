@@ -195,6 +195,11 @@ class TableJournal:
         self._fh = None
         self._fh_bytes = 0
         self._since_fsync = 0
+        #: () -> int: extra durable bytes (the table's cold-tier segments)
+        #: charged against PL_JOURNAL_MAX_MB — demoted data already lives
+        #: on disk once, so the replay window shrinks by what the cold tier
+        #: holds instead of double-holding it (set by attach_store)
+        self.extra_disk = None
         segs = self.segments()
         self._seg_no = (int(os.path.basename(segs[-1])[4:12]) if segs else 0)
 
@@ -305,6 +310,11 @@ class TableJournal:
         segs = self.segments()
         sizes = {p: os.path.getsize(p) for p in segs}
         total = sum(sizes.values())
+        if self.extra_disk is not None:
+            try:
+                total += int(self.extra_disk())
+            except Exception:
+                pass
         for p in segs[:-1]:
             if total <= budget:
                 break
@@ -386,14 +396,30 @@ def replay_table(table, journal: TableJournal) -> dict:
         meta, data = decode_write_record(payload)
         if first:
             first = False
-            if table._total_rows_written == 0 and int(meta["wm"]) > 0:
+            wm0 = int(meta["wm"])
+            if table._total_rows_written == 0 and wm0 > 0:
                 # pruned head (PL_JOURNAL_MAX_MB): advance the FRESH
                 # store's frontier so the replayed tail keeps ABSOLUTE row
                 # ids — rows below it count as expired-before-restore
                 # (size the budget ≥ the table's retention bytes and they
                 # are also retention-expired).  Watermarks stay absolute,
                 # so peer-fetch coverage arithmetic stays consistent.
-                table.advance_row_frontier(int(meta["wm"]))
+                table.advance_row_frontier(wm0)
+                metrics.counter_inc(
+                    "px_journal_pruned_head_replays_total",
+                    help_="replays that began past a pruned journal head")
+            elif (getattr(table, "_cold_rows_adopted", 0)
+                  and table._hot_rows == 0
+                  and 0 < table._total_rows_written < wm0):
+                # cold segments restored BELOW a pruned journal head: the
+                # ids between the cold tail and the journal head are rows
+                # that expired before the crash (the prune budget charges
+                # cold bytes via extra_disk, so pruning past live rows
+                # requires budget < retention, same contract as above).
+                # Bridge the gap so the tail keeps absolute ids; without
+                # this the wm>have check below reads a legitimate pruned
+                # head as a hole and drops the whole journal tail.
+                table.advance_row_frontier(wm0, allow_gap=True)
                 metrics.counter_inc(
                     "px_journal_pruned_head_replays_total",
                     help_="replays that began past a pruned journal head")
@@ -428,10 +454,12 @@ def attach_store(store, ndir: str) -> dict:
     (and tables found only on disk — recreated from their schema.json),
     then journal every future write.  New tables created later (tracepoint
     deploys) attach via a store observer.  Returns replay stats."""
+    from pixie_tpu.table import lifecycle as _lifecycle  # local: import cycle
     from pixie_tpu.table.table import Table, TableStore  # local: import cycle
 
     assert isinstance(store, TableStore)
-    stats = {"tables": 0, "applied": 0, "rows": 0, "truncated": 0}
+    stats = {"tables": 0, "applied": 0, "rows": 0, "truncated": 0,
+             "cold_restored": 0}
     jroot = os.path.join(ndir, "journal")
     os.makedirs(jroot, exist_ok=True)
     # tables known only to the journal (a fresh store after pod loss):
@@ -453,11 +481,16 @@ def attach_store(store, ndir: str) -> dict:
         jdir = _journal_dir(ndir, name)
         j = TableJournal(jdir)
         stats["truncated"] += j.recover()
+        # cold tier restores BEFORE replay: replay's watermark idempotence
+        # then skips the journal records the adopted cold rows came from
+        stats["cold_restored"] += _lifecycle.attach_table(t, ndir)
         r = replay_table(t, j)
         stats["applied"] += r["applied"]
         stats["rows"] += r["rows"]
         _write_schema(jdir, t)
         t.journal = j
+        if t.cold is not None:
+            j.extra_disk = t.cold.disk_usage_bytes
         stats["tables"] += 1
 
     def _on_table(table) -> None:
@@ -466,9 +499,12 @@ def attach_store(store, ndir: str) -> dict:
             jdir = _journal_dir(ndir, table.name)
             j = TableJournal(jdir)
             j.recover()
+            _lifecycle.attach_table(table, ndir)
             replay_table(table, j)
             _write_schema(jdir, table)
             table.journal = j
+            if table.cold is not None:
+                j.extra_disk = table.cold.disk_usage_bytes
 
     store.add_observer(_on_table)
     return stats

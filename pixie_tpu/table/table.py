@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Iterator, Optional
 
 import numpy as np
@@ -50,7 +51,8 @@ _pxlint_locks_ = {
 
 
 class _SealedBatch:
-    __slots__ = ("batch", "row_id_start", "min_time", "max_time", "nbytes", "gen")
+    __slots__ = ("batch", "row_id_start", "min_time", "max_time", "nbytes",
+                 "gen", "num_rows", "sealed_at")
 
     def __init__(self, batch: RowBatch, row_id_start: int, time_col: str | None, gen: int):
         self.batch = batch
@@ -64,6 +66,11 @@ class _SealedBatch:
             self.min_time = None
             self.max_time = None
         self.nbytes = batch.nbytes()
+        #: row count + seal time as METADATA (not via .batch) so the cold
+        #: tier's demoted stubs (table.lifecycle._ColdBatch, same duck-type)
+        #: can answer size/age questions without decoding from disk
+        self.num_rows = batch.num_rows
+        self.sealed_at = time.monotonic()
 
 
 class Table:
@@ -94,6 +101,15 @@ class Table:
         #: newly sealed batches of one write — services/replication.py ships
         #: them to this shard's replica peers
         self.on_seal = None
+        #: compressed on-disk cold tier (table.lifecycle.ColdTier) —
+        #: attached by journal.attach_store when PL_COLD_TIER is on (or cold
+        #: segments already exist on disk); None = the all-RAM seed
+        #: behavior, bit-identical paths
+        self.cold = None
+        #: batches adopted from the cold tier at restore time (journal
+        #: replay uses this to tell a legitimate pruned-head gap from
+        #: corruption when the replay head starts past the frontier)
+        self._cold_rows_adopted = 0
         self._sealed: list[_SealedBatch] = []
         self._hot: dict[str, list[np.ndarray]] = {c.name: [] for c in relation}
         self._hot_rows = 0
@@ -235,11 +251,23 @@ class Table:
 
     def _expire_locked(self):
         # Ring-buffer semantics: oldest sealed batches fall off when over budget
-        # (reference table.h expiry by table_size_limit).
+        # (reference table.h expiry by table_size_limit).  With a cold tier
+        # attached retention becomes DEMOTE then expire: the age/RAM-ceiling
+        # pass runs first, and budget pressure spills the oldest RAM batch
+        # to disk before any row is dropped.
         expired = False
+        if self.cold is not None:
+            expired = self.cold.manage_locked()
         while self._sealed and self._sealed_bytes + self._hot_bytes_locked() > self.max_bytes:
+            if self.cold is not None and self.cold.demote_oldest_locked():
+                continue
             sb = self._sealed.pop(0)
-            self._sealed_bytes -= sb.nbytes
+            if getattr(sb, "is_cold", False) and not sb.in_ram:
+                # cold entries hold no RAM budget; dropping one is cold-tier
+                # bookkeeping (file delete + in-memory carry for snapshots)
+                self.cold.on_drop_locked(sb)
+            else:
+                self._sealed_bytes -= sb.nbytes
             self._expired_batches += 1
             expired = True
         if expired:
@@ -304,20 +332,90 @@ class Table:
         with self._lock:
             return self._next_row_id + self._hot_rows
 
-    def advance_row_frontier(self, row_id: int) -> None:
+    def advance_row_frontier(self, row_id: int, allow_gap: bool = False) -> None:
         """Pre-advance an EMPTY table's row-id space to `row_id`: rows
         below it count as expired-before-restore.  Journal replay uses
         this when the journal head was pruned (PL_JOURNAL_MAX_MB), so the
         replayed tail keeps its ABSOLUTE row ids — peer-fetch coverage
         arithmetic and watermark accounting stay consistent across every
-        consumer instead of silently renumbering rows from zero."""
+        consumer instead of silently renumbering rows from zero.
+
+        `allow_gap=True` advances the frontier of a NON-empty table past
+        its tail (hot side must be empty): restore uses it when cold
+        segments were adopted but the journal head above them was pruned —
+        the missing ids are rows that expired before the crash.  Sealed
+        batches keep their own absolute ids, so the gap never shifts data."""
         with self._lock:
+            if allow_gap:
+                if self._hot_rows or int(row_id) < self._next_row_id:
+                    raise InvalidArgument(
+                        f"advance_row_frontier(allow_gap) on {self.name}: "
+                        f"frontier {self._next_row_id} hot {self._hot_rows} "
+                        f"target {row_id}")
+                self._next_row_id = int(row_id)
+                self._total_rows_written = int(row_id)
+                return
             if (self._sealed or self._hot_rows
                     or self._total_rows_written):
                 raise InvalidArgument(
                     f"advance_row_frontier on non-empty table {self.name}")
             self._next_row_id = int(row_id)
             self._total_rows_written = int(row_id)
+
+    def adopt_cold_batches(self, entries) -> int:
+        """Adopt restored cold-tier batch stubs (lifecycle.ColdTier.
+        restore_into) into an EMPTY table, oldest first.  Entries must be
+        contiguous in row-id space; adoption stops at the first gap (a
+        lost middle segment must not splice disjoint row ranges into one
+        ring).  Runs BEFORE journal replay, so replay's watermark
+        idempotence skips the journal records these rows came from.
+        Returns the number of entries adopted."""
+        adopted = 0
+        with self._lock:
+            if self._sealed or self._hot_rows or self._total_rows_written:
+                raise InvalidArgument(
+                    f"adopt_cold_batches on non-empty table {self.name}")
+            for e in entries:
+                if adopted == 0:
+                    self._next_row_id = e.row_id_start
+                    self._total_rows_written = e.row_id_start
+                elif e.row_id_start != self._next_row_id:
+                    break
+                e.gen = self._next_gen
+                self._next_gen += 1
+                self._sealed.append(e)
+                self._next_row_id += e.num_rows
+                self._total_rows_written += e.num_rows
+                adopted += 1
+            self._cold_rows_adopted = adopted
+        return adopted
+
+    def seal_hot(self) -> int:
+        """Force-seal the hot remainder as ONE short sealed batch (fewer
+        than batch_rows rows) — re-homing prep: a donor must get EVERY row
+        into replicable sealed form before the shard map flips, and only
+        sealed batches travel the replication channel.  The short batch is
+        a normal sealed gen (device-cacheable, shipped via on_seal like any
+        seal).  Returns rows sealed."""
+        with self._lock:
+            n = self._hot_rows
+            if n == 0:
+                return 0
+            merged = self._take_hot_locked()
+            rb = RowBatch(self.relation, merged)
+            sb = _SealedBatch(rb, self._next_row_id, self.time_col,
+                              self._next_gen)
+            self._next_gen += 1
+            self._sealed.append(sb)
+            self._sealed_bytes += sb.nbytes
+            self._next_row_id += rb.num_rows
+            self._hot = {c.name: [] for c in self.relation}
+            self._hot_rows = 0
+            self._snap_cache = None
+            new_sealed = [sb]
+        if self.on_seal is not None:
+            self.on_seal(self, new_sealed)
+        return n
 
     def first_row_id(self) -> int:
         """Row id of the oldest RETAINED row — the ring-buffer expiry
@@ -354,7 +452,9 @@ class Table:
             )
             items: list[_SealedBatch] = []
             for sb in self._sealed:
-                n = sb.batch.num_rows
+                # metadata only — touching sb.batch here would decode every
+                # cold segment on every streaming poll
+                n = sb.num_rows
                 lo_off = max(0, row_id - sb.row_id_start)
                 hi_off = min(n, hi - sb.row_id_start)
                 if hi_off <= 0 or lo_off >= n:
@@ -362,6 +462,10 @@ class Table:
                 if lo_off == 0 and hi_off == n:
                     items.append(sb)
                 else:
+                    # partial overlap slices through sb.batch — for a cold
+                    # entry this decodes under the lock, but only a delta
+                    # scan whose watermark lands INSIDE an already-cold
+                    # batch gets here (streaming reads the fresh tail)
                     rb = RowBatch(
                         self.relation,
                         {k: v[lo_off:hi_off] for k, v in sb.batch.columns.items()},
@@ -394,6 +498,7 @@ class Table:
                 "bytes": self._sealed_bytes + self._hot_bytes_locked(),
                 "expired_batches": self._expired_batches,
                 "dict_sizes": {k: d.size for k, d in self.dictionaries.items()},
+                "cold": self.cold.stats() if self.cold is not None else None,
             }
 
     def nbytes(self) -> int:
@@ -428,19 +533,32 @@ class Cursor:
         #: poll's delta fills the cache with dead entries (measured: poll
         #: latency degrading 10x over a 100M-row stream)
         self.is_delta = is_delta
-        self._items: list[tuple[RowBatch, int, int | None]] = []
+        #: item[0] is a RowBatch for RAM-resident data, or a cold-tier stub
+        #: (lifecycle._ColdBatch) whose .batch decodes from disk — iteration
+        #: materializes cold segments lazily, so building a cursor over a
+        #: mostly-cold retention window stays O(metadata)
+        self._items: list[tuple[object, int, int | None]] = []
         #: (min_time, max_time) per item, from seal-time metadata; None = unknown
         #: (hot remainder) — aligned with _items for O(batches) time_range().
         self._bounds: list[tuple[int, int] | None] = []
+        cold: set[int] = set()
         for sb in sealed:
             if start_time is not None and sb.max_time is not None and sb.max_time < start_time:
                 continue
             if stop_time is not None and sb.min_time is not None and sb.min_time >= stop_time:
                 continue
-            self._items.append((sb.batch, sb.row_id_start, sb.gen))
+            if getattr(sb, "is_cold", False) and not sb.in_ram:
+                self._items.append((sb, sb.row_id_start, sb.gen))
+                cold.add(sb.gen)
+            else:
+                self._items.append((sb.batch, sb.row_id_start, sb.gen))
             self._bounds.append(
                 (sb.min_time, sb.max_time) if sb.min_time is not None else None
             )
+        #: gens that were on disk at snapshot time — the executor flushes
+        #: feeds at cold↔RAM boundaries, serves these under the `cold` heat
+        #: tier and keeps them out of the device feed caches
+        self.cold_gens = frozenset(cold)
         if hot is not None:
             tc = table.time_col
             keep = True
@@ -455,13 +573,29 @@ class Cursor:
                 self._bounds.append(None)
 
     def __iter__(self) -> Iterator[tuple[RowBatch, int, int | None]]:
-        return iter(self._items)
+        if not self.cold_gens:
+            return iter(self._items)  # all-RAM: the zero-overhead seed path
+        return self._iter_decoding()
+
+    def _iter_decoding(self) -> Iterator[tuple[RowBatch, int, int | None]]:
+        for obj, rid, gen in self._items:
+            yield (obj if isinstance(obj, RowBatch) else obj.batch), rid, gen
+
+    def iter_meta(self) -> Iterator[tuple[int, int, int | None]]:
+        """(rows, row_id_start, gen) per item WITHOUT materializing data —
+        the executor's feed-shape predictor sizes pad buckets from counts
+        alone, so it must never decode cold segments."""
+        for obj, rid, gen in self._items:
+            n = obj.num_valid if isinstance(obj, RowBatch) else obj.num_rows
+            yield n, rid, gen
 
     def __len__(self) -> int:
         return len(self._items)
 
     def num_rows(self) -> int:
-        return sum(b.num_valid for b, _, _ in self._items)
+        return sum(
+            (b.num_valid if isinstance(b, RowBatch) else b.num_rows)
+            for b, _, _ in self._items)
 
     def time_range(self) -> tuple[int, int] | None:
         """(min, max) time over the snapshot, using seal-time bounds — only the
@@ -472,6 +606,8 @@ class Cursor:
         t_min = t_max = None
         for (b, _rid, _gen), bounds in zip(self._items, self._bounds):
             if bounds is None:
+                if not isinstance(b, RowBatch):
+                    b = b.batch
                 t = b.columns[tc][: b.num_valid]
                 if not len(t):
                     continue

@@ -176,6 +176,8 @@ st = st.groupby(['agent', 'table_name']).agg(
     hot_rows=('hot_rows', px.max),
     sealed_batches=('sealed_batches', px.max),
     sealed_bytes=('sealed_bytes', px.max),
+    cold_bytes=('cold_bytes', px.max),
+    cold_segments=('cold_segments', px.max),
     journal_bytes=('journal_bytes', px.max),
     repl_lag=('repl_lag_batches', px.max),
 )

@@ -634,6 +634,76 @@ def test_elastic_ramp_harness_crash_fails_guards():
     assert all(r.get("missing") for r in regs)
 
 
+# ----------------------------------------------------- elastic_rebalance
+
+
+def _rebalance_doc(rows=12, moves=1, demotions=38, bit_equal=1.0,
+                   skew=1.0, row_loss=0, errors=0, ram_peak=1.0,
+                   goodput=50.0, p99=700.0):
+    doc = _doc()
+    doc["configs"]["elastic_rebalance"] = {
+        "rows": rows, "duration_s": 16.6, "queries": 900,
+        "goodput_qps": goodput, "p99_ms": p99, "client_errors": errors,
+        "bit_equal_frac": bit_equal, "moves": moves, "move_refusals": 0,
+        "skew_final": skew, "skew_mean_final": 1.5, "row_loss": row_loss,
+        "rows_total": 228_000, "demotions": demotions,
+        "hot_ram_peak_mb": ram_peak,
+        "agents_final": ["pem1", "pem2", "spare0"],
+    }
+    return doc
+
+
+def test_elastic_rebalance_points_guarded():
+    """elastic_rebalance is a guarded goodput AND latency config
+    (shape-matched on the high-phase client count)."""
+    pts = bench.bench_points(_rebalance_doc())
+    assert pts["configs.elastic_rebalance.goodput_qps"] == (50.0, 12)
+    lpts = bench.bench_latency_points(_rebalance_doc())
+    assert lpts["configs.elastic_rebalance.p99_ms"] == (700.0, 12)
+
+
+def test_elastic_rebalance_absolute_guards():
+    """The ROADMAP-2 data-lifecycle acceptance holds ABSOLUTELY: the hot
+    shard moved, the cold tier demoted, zero loss, bit-equal answers,
+    settled skew, zero client errors, bounded sealed RAM."""
+    assert bench.absolute_floors(_rebalance_doc()) == []
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(moves=0))] == ["configs.elastic_rebalance.moves"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(demotions=0))] == [
+            "configs.elastic_rebalance.demotions"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(bit_equal=0.999))] == [
+            "configs.elastic_rebalance.bit_equal_frac"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(skew=1.4))] == [
+            "configs.elastic_rebalance.skew_final"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(row_loss=24_000))] == [
+            "configs.elastic_rebalance.row_loss"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(errors=3))] == [
+            "configs.elastic_rebalance.client_errors"]
+    assert [r["key"] for r in bench.absolute_floors(
+        _rebalance_doc(ram_peak=4.2))] == [
+            "configs.elastic_rebalance.hot_ram_peak_mb"]
+    # smoke/quick shapes never trip the full-shape bounds
+    assert bench.absolute_floors(
+        _rebalance_doc(rows=8, moves=0, demotions=0, row_loss=9)) == []
+
+
+def test_elastic_rebalance_harness_crash_fails_guards():
+    """A crashed rebalance harness at the guarded shape must TRIP the
+    absolute guards (missing-key rule), never silently disable them."""
+    doc = _doc()
+    doc["configs"]["elastic_rebalance"] = {"rows": 12, "error": "boom"}
+    regs = bench.absolute_floors(doc)
+    assert len(regs) >= 7
+    assert all(r["key"].startswith("configs.elastic_rebalance")
+               for r in regs)
+    assert all(r.get("missing") for r in regs)
+
+
 # --------------------------------------------------------- adaptive_gates
 
 

@@ -648,3 +648,106 @@ def test_matview_snapshot_rejects_stale_or_torn(tmp_path):
     mgr2 = MatViewManager(ts)
     mgr2.set_snapshot_dir(str(tmp_path / "mv"))
     assert mgr2.serve(_plan()) is None  # falls back to register-only
+
+
+# --------------------------------------------------------- chaos during move
+
+
+def test_rehome_incarnation_fence_aborts_and_donor_keeps_owning(tmp_path):
+    """ISSUE 18 chaos: the donor 'restarts' mid-move (incarnation bump
+    between prepare and verify).  The fence must abort the move before
+    commit: staged replica unstaged, durable move/ record gone, ownership
+    with the donor, every acknowledged row still served bit-equal."""
+    broker, agents = _start_cluster(tmp_path)
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    try:
+        base = canonical_bytes(client.execute_script(AGG_SCRIPT))
+        own_map = broker.registry.shard_map()
+        real_rpc = broker._agent_rpc
+        real_inc = broker.registry.incarnation
+        restarted = {"flag": False}
+
+        def chaos_rpc(name, payload, timeout=5.0):
+            res = real_rpc(name, payload, timeout=timeout)
+            if payload.get("msg") == "rehome_prepare":
+                restarted["flag"] = True  # donor "restarts" after prepare
+            return res
+
+        def chaos_inc(name):
+            inc = real_inc(name)
+            if restarted["flag"] and name == "pem0":
+                return inc + 1000
+            return inc
+
+        broker._agent_rpc = chaos_rpc
+        broker.registry.incarnation = chaos_inc
+        try:
+            res = broker.rehome_agent("pem0", target="pem2", reason="chaos")
+        finally:
+            broker._agent_rpc = real_rpc
+            broker.registry.incarnation = real_inc
+        assert not res["ok"]
+        assert res["reason"] == "incarnation changed mid-move"
+        assert metrics.counter_value("px_rehome_aborts_total") >= 1
+        # abort left no trace: no move record, no staged replica, and the
+        # shard map owns exactly what it owned before the move started
+        assert list(broker.kv.scan("move/")) == []
+        assert broker.registry.extra_replicas("pem0") == []
+        assert broker.registry.shard_map() == own_map
+        # zero loss: the donor still owns and serves its shard bit-equal
+        assert canonical_bytes(client.execute_script(AGG_SCRIPT)) == base
+        # and the aborted move left the donor fully retryable
+        res2 = broker.rehome_agent("pem0", target="pem2", reason="retry")
+        assert res2["ok"], res2
+        assert canonical_bytes(client.execute_script(AGG_SCRIPT)) == base
+    finally:
+        _stop_cluster(broker, agents, client)
+
+
+def test_rehome_then_donor_death_serves_from_target(tmp_path):
+    """After a committed move the staged copy leads the donor's replica
+    list — a donor that dies WITHOUT retiring must fail over onto the
+    re-homed target, bit-equal (the extras-first map ordering under real
+    failover, not just in the registry)."""
+    broker, agents = _start_cluster(tmp_path)
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    try:
+        base = canonical_bytes(client.execute_script(AGG_SCRIPT))
+        res = broker.rehome_agent("pem0", target="pem2", reason="drain")
+        assert res["ok"], res
+        assert broker.registry.shard_map()["pem0"][0] == "pem2"
+        agents["pem0"]._pod_kill()  # store GONE — no retire, raw death
+        agents["pem0"].conn.abort()
+        time.sleep(0.6)  # past the rejoin grace
+        out = client.execute_script(AGG_SCRIPT)
+        assert canonical_bytes(out) == base
+        stats = next(iter(out.values())).exec_stats
+        assert stats["agents"]["pem0"].get("takeover", {}).get(
+            "replica") == "pem2"
+    finally:
+        _stop_cluster(broker, agents, client)
+
+
+def test_rehome_survives_broker_restart_mid_prepare(tmp_path):
+    """Broker dies between staging and commit: the restarted broker's
+    _abort_stale_moves unstages the extra replica, deletes the move
+    record, and the donor serves on, owning its shard."""
+    broker, agents = _start_cluster(tmp_path)
+    client = Client("127.0.0.1", broker.port, timeout_s=30.0)
+    try:
+        base = canonical_bytes(client.execute_script(AGG_SCRIPT))
+        # freeze the move mid-prepare: durable record + staged replica,
+        # then the broker "crashes" before verify/commit
+        broker.kv.set_json("move/pem0", {
+            "target": "pem2", "reason": "chaos", "phase": "prepare"})
+        broker.registry.add_replica("pem0", "pem2")
+        assert broker.registry.extra_replicas("pem0") == ["pem2"]
+        stale0 = metrics.counter_value("px_rehome_stale_aborts_total")
+        broker._abort_stale_moves()  # what Broker.start() replays
+        assert metrics.counter_value(
+            "px_rehome_stale_aborts_total") == stale0 + 1
+        assert list(broker.kv.scan("move/")) == []
+        assert broker.registry.extra_replicas("pem0") == []
+        assert canonical_bytes(client.execute_script(AGG_SCRIPT)) == base
+    finally:
+        _stop_cluster(broker, agents, client)

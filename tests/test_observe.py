@@ -116,6 +116,12 @@ def _self_telemetry_rows(ts):
         "journal_bytes": 100 * i, "journal_segments": 1,
         "repl_lag_batches": 0, "peer_lag": "",
     } for i in range(6)])
+    observe.write_rows(ts, observe.SCALE_EVENTS_TABLE, [{
+        "time_": 10 ** 15 + i,
+        "action": ("up", "rehome", "rebalance")[i % 3],
+        "agent": f"pem{i % 2}", "reason": "pressure",
+        "pressure": 0.5 + i, "agents": 2 + i % 2,
+    } for i in range(6)])
 
 
 # ---------------------------------------------------------------- unit layer

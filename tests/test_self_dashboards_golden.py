@@ -117,6 +117,8 @@ def _storage_state_rows() -> list[dict]:
                     "hot_rows": int(rng.integers(0, 5000)),
                     "sealed_batches": int(rng.integers(0, 30)),
                     "sealed_bytes": int(rng.integers(0, 10**7)),
+                    "cold_bytes": int(rng.integers(0, 10**6)),
+                    "cold_segments": int(rng.integers(0, 12)),
                     "age_histogram": json.dumps({"<10m": 3, "old": 2}),
                     "resident_bytes": int(rng.integers(0, 10**6)),
                     "matview_bytes": int(rng.integers(0, 10**5)),
@@ -129,6 +131,21 @@ def _storage_state_rows() -> list[dict]:
     return rows
 
 
+def _scale_event_rows() -> list[dict]:
+    rows = []
+    actions = ("up", "down", "rehome", "rebalance", "refuse")
+    for i in range(25):
+        rows.append({
+            "time_": 100 * SEC + i,
+            "action": actions[i % len(actions)],
+            "agent": f"pem{i % 3}",
+            "reason": "heat skew" if i % 2 else "drain -> pem9",
+            "pressure": round(0.1 * i, 2),
+            "agents": 3 + i % 2,
+        })
+    return rows
+
+
 @pytest.fixture(scope="module")
 def store():
     ts = TableStore()
@@ -138,6 +155,7 @@ def store():
     observe.write_rows(ts, observe.SHARD_HEAT_TABLE, _shard_heat_rows())
     observe.write_rows(ts, observe.STORAGE_STATE_TABLE,
                        _storage_state_rows())
+    observe.write_rows(ts, observe.SCALE_EVENTS_TABLE, _scale_event_rows())
     return ts
 
 
@@ -265,10 +283,20 @@ def test_storage_state_golden(store):
         hot_rows=("hot_rows", "max"),
         sealed_batches=("sealed_batches", "max"),
         sealed_bytes=("sealed_bytes", "max"),
+        cold_bytes=("cold_bytes", "max"),
+        cold_segments=("cold_segments", "max"),
         journal_bytes=("journal_bytes", "max"),
         resident_bytes=("resident_bytes", "max"),
         matview_bytes=("matview_bytes", "max"),
         repl_lag_batches=("repl_lag_batches", "max"))
+    assert_frames(res, exp)
+
+
+def test_shard_moves_golden(store):
+    res = _run(store, "self_storage", "shard_moves")
+    df = pd.DataFrame(_scale_event_rows())
+    exp = df[df["action"].isin(["rehome", "rebalance"])]
+    exp = exp[["time_", "action", "agent", "reason", "agents"]]
     assert_frames(res, exp)
 
 
